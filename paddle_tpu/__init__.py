@@ -1,0 +1,85 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+API surface, built from scratch on JAX/XLA/Pallas.
+
+``import paddle_tpu as paddle`` is the intended usage: the public names
+mirror ``paddle.*`` (see SURVEY.md for the reference component map).
+"""
+from __future__ import annotations
+
+from .version import __version__
+
+# core
+from .core.tensor import Tensor, Parameter, to_tensor
+from .core.autograd import (
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+    grad,
+)
+from .core.dtype import (
+    DType, dtype, bfloat16, float16, float32, float64, int8, int16, int32,
+    int64, uint8, bool_ as bool8, complex64, complex128, float8_e4m3fn,
+    float8_e5m2, get_default_dtype, set_default_dtype, finfo, iinfo,
+)
+from .core.place import (
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, CustomPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_xpu, is_compiled_with_custom_device,
+)
+from .core.random import seed, get_rng_state, set_rng_state
+from .core.flags import set_flags, get_flags
+
+# the op corpus (also patches Tensor methods)
+from .tensor import *  # noqa: F401,F403
+from . import tensor as tensor  # noqa: PLC0414
+
+# `paddle.bool` is the dtype; paddle shadows the builtin here and so do we.
+bool = bool8
+
+_static_mode = False
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def get_cudnn_version():
+    return None
+
+
+class batch:
+    """paddle.batch generator wrapper (legacy reader API)."""
+
+    def __init__(self, reader, batch_size, drop_last=False):
+        self.reader, self.batch_size, self.drop_last = reader, batch_size, drop_last
+
+    def __call__(self):
+        buf = []
+        for item in self.reader():
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield buf
+                buf = []
+        if buf and not self.drop_last:
+            yield buf
+
+
+# Subsystem namespaces (populated progressively; each mirrors paddle.<ns>).
+from . import autograd  # noqa: E402

@@ -1,0 +1,70 @@
+"""paddle.autograd namespace (reference: python/paddle/autograd/)."""
+from ..core.autograd import backward, grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled", "PyLayer"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom-op autograd extension point (reference: paddle.autograd.PyLayer).
+
+    Subclasses define static forward(ctx, *args) and backward(ctx, *grads)
+    written in paddle_tpu ops; apply() stitches them into the tape via a
+    jax.custom_vjp-free manual node.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor
+        from ..core import autograd as ag
+        import weakref
+        import jax
+
+        ctx = PyLayerContext()
+        with ag.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(out, Tensor)
+        outs = [out] if single else list(out)
+        diff_inputs = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        if ag.is_grad_enabled() and diff_inputs:
+            import jax.numpy as jnp
+
+            def vjp_fn(cots):
+                cots = cots if isinstance(cots, tuple) else (cots,)
+                with ag.no_grad():
+                    gin = cls.backward(ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+                gin = (gin,) if isinstance(gin, Tensor) else tuple(gin)
+                # align returned grads with diff inputs (paddle returns one
+                # grad per forward tensor input, in order)
+                t_inputs = [a for a in args if isinstance(a, Tensor)]
+                grads = []
+                for t, g in zip(t_inputs, gin):
+                    if not t.stop_gradient:
+                        grads.append(g._value if isinstance(g, Tensor) else g)
+                return tuple(grads)
+
+            flat, treedef = jax.tree_util.tree_flatten(tuple(t._value for t in outs))
+            node = ag.Node(
+                vjp_fn,
+                [t._ensure_slot() for t in diff_inputs],
+                [],
+                treedef,
+                name=cls.__name__,
+            )
+            for t in outs:
+                t._stop_gradient = False
+                slot = ag.GradSlot(owner=t, node=node)
+                t._slot = slot
+                node.outputs.append((slot, tuple(t._value.shape), t._value.dtype))
+        return out

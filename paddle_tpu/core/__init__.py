@@ -1,0 +1,1 @@
+"""Core runtime: tensor, autograd, dispatch, dtype/place, RNG, flags."""

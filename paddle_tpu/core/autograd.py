@@ -1,0 +1,306 @@
+"""Define-by-run autograd on a functional substrate.
+
+The reference's eager engine wires generated GradNodes through AutogradMeta
+and walks them queue-style in ``egr::Backward`` (reference:
+paddle/fluid/eager/backward.cc — unverified, SURVEY.md §0). Here every
+differentiable op records one ``Node`` holding a ``jax.vjp`` closure; the
+forward runs exactly once (inside ``jax.vjp``), residuals live in the
+closure, and ``backward()`` is a reverse-topological walk accumulating
+cotangents. The whole tape is pure Python over jax values, so it works
+identically on concrete arrays (eager) and tracers (inside ``jax.jit``).
+
+Tensor *versions* are tracked with ``GradSlot`` objects: an in-place op
+rebinds the Python Tensor to a fresh slot while recorded nodes keep
+referencing the old version's slot — the functional analog of the
+reference's inplace version counters, without their error cases.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+
+import numpy as np
+import jax
+
+__all__ = [
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "GradSlot",
+    "Node",
+    "backward",
+    "grad",
+]
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+class _GradMode:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradMode(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad(func=None):
+    """paddle.no_grad: usable as context manager or decorator."""
+    if func is not None:
+        return _GradMode(False)(func)
+    return _GradMode(False)
+
+
+def enable_grad(func=None):
+    if func is not None:
+        return _GradMode(True)(func)
+    return _GradMode(True)
+
+
+class set_grad_enabled(_GradMode):
+    pass
+
+
+class GradSlot:
+    """Identity of one tensor *version* in the autograd graph."""
+
+    __slots__ = ("node", "owner_ref", "__weakref__")
+
+    def __init__(self, owner=None, node=None):
+        self.node = node  # producing Node, or None for leaves
+        self.owner_ref = weakref.ref(owner) if owner is not None else None
+
+    def owner(self):
+        return self.owner_ref() if self.owner_ref is not None else None
+
+
+class Node:
+    """One recorded op: cotangents in → input cotangents out."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "treedef", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, outputs, treedef, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[GradSlot] — the differentiable inputs
+        self.outputs = outputs  # list[(GradSlot, shape, jnp_dtype)]
+        self.treedef = treedef  # structure of the raw fn output
+        self.name = name
+
+    def __repr__(self):
+        return f"<Node {self.name or 'op'} n_in={len(self.inputs)}>"
+
+
+def _zero_cotangent(shape, dtype):
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # Integer/bool outputs take symbolic-zero float0 cotangents.
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def _toposort(root_slots):
+    """Topological order (producers first) over reachable Nodes."""
+    order, seen = [], set()
+    stack = [(s.node, False) for s in root_slots if s.node is not None]
+    while stack:
+        node, processed = stack.pop()
+        if node is None:
+            continue
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for s in node.inputs:
+            if s.node is not None and id(s.node) not in seen:
+                stack.append((s.node, False))
+    return order
+
+
+def _run_hooks(owner, g):
+    from .tensor import Tensor
+
+    if owner is None:
+        return g
+    for hook in owner._grad_hooks:
+        new_g = hook(Tensor(g, stop_gradient=True))
+        if new_g is not None:
+            g = new_g._value if isinstance(new_g, Tensor) else new_g
+    return g
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
+    """Run reverse accumulation from ``tensors``.
+
+    Matches paddle.autograd.backward semantics: default cotangent is ones
+    for scalar outputs; ``.grad`` is accumulated (+=) on leaves. With
+    ``_grad_sink`` (a dict), grads are collected into the sink keyed by
+    ``id(owner)`` instead of written to ``.grad`` — used by paddle.grad so
+    it never pollutes ``.grad`` of uninvolved leaves.
+    """
+    from .tensor import Tensor
+    import jax.numpy as jnp
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    cotangents: dict[int, object] = {}
+    keepalive: dict[int, GradSlot] = {}
+
+    def _deliver(owner, g):
+        if _grad_sink is not None:
+            oid = id(owner)
+            _grad_sink[oid] = _grad_sink[oid] + g if oid in _grad_sink else g
+        else:
+            owner._set_grad_accum(g)
+
+    def _accum(slot, g):
+        sid = id(slot)
+        keepalive[sid] = slot
+        if sid in cotangents:
+            cotangents[sid] = cotangents[sid] + g
+        else:
+            cotangents[sid] = g
+
+    root_slots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True"
+            )
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward roots; "
+                    f"got shape {t.shape}"
+                )
+            g = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        slot = t._ensure_slot()
+        _accum(slot, g)
+        root_slots.append(slot)
+
+    order = _toposort(root_slots)
+
+    for node in reversed(order):
+        cots = []
+        any_live = False
+        for slot, shape, dt in node.outputs:
+            g = cotangents.get(id(slot))
+            owner = slot.owner()
+            if g is None:
+                g = _zero_cotangent(shape, dt)
+            else:
+                any_live = True
+                g = _run_hooks(owner, g)
+                if owner is not None and (
+                    owner._retain_grad_flag and not owner.stop_gradient
+                ):
+                    _deliver(owner, g)
+            cots.append(g)
+        if not any_live or node.vjp_fn is None:
+            continue
+        cot_struct = jax.tree_util.tree_unflatten(node.treedef, cots)
+        in_grads = node.vjp_fn(cot_struct)
+        for slot, g in zip(node.inputs, in_grads):
+            _accum(slot, g)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+
+    # Write .grad on leaves.
+    for sid, slot in keepalive.items():
+        if slot.node is None:
+            owner = slot.owner()
+            if owner is not None and not owner.stop_gradient:
+                g = _run_hooks(owner, cotangents[sid])
+                _deliver(owner, g)
+
+    if not retain_graph:
+        for slot in keepalive.values():
+            owner = slot.owner()
+            if owner is not None:
+                owner._slot = None  # release graph
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """paddle.grad: grads of ``outputs`` w.r.t. ``inputs`` (always a list).
+
+    ``create_graph`` (double backward) is not supported in round 1 — the
+    perf path for higher-order grads is ``paddle.jit`` + ``jax.grad``
+    composition.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported in eager mode; compose "
+            "paddle_tpu.jit grad transforms instead"
+        )
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    saved = [(t, t._retain_grad_flag) for t in inputs]
+    for t in inputs:
+        t._retain_grad_flag = True  # collect even if t is an intermediate
+    sink: dict[int, object] = {}
+    try:
+        backward(
+            outputs, grad_outputs, retain_graph=bool(retain_graph),
+            _grad_sink=sink,
+        )
+        results = []
+        for t in inputs:
+            g = sink.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the inputs was not used in the graph; pass "
+                        "allow_unused=True to return None for it"
+                    )
+                results.append(None)
+            else:
+                results.append(Tensor(g, stop_gradient=True))
+    finally:
+        for t, flag in saved:
+            t._retain_grad_flag = flag
+    return results  # paddle.grad always returns a list

@@ -1,0 +1,123 @@
+"""Op dispatch: the Phi-analog single seam every op goes through.
+
+The reference dispatches (op, backend, layout, dtype) → kernel via
+``phi::KernelFactory`` (reference: paddle/phi/core/kernel_factory.cc —
+unverified, SURVEY.md §0). Here the "kernel" is always a pure JAX function
+and the dispatcher's job is autograd recording: run the function under
+``jax.vjp`` when any input needs grad, wrap outputs as Tensors, and attach
+one tape Node. Works on concrete arrays and on tracers (inside jit) alike.
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .flags import get_flags
+
+__all__ = ["apply", "unwrap", "wrap_single", "OP_REGISTRY", "register_op"]
+
+# op name → python callable (introspection / paddle "kernel registry" analog)
+OP_REGISTRY: dict[str, object] = {}
+
+
+def register_op(name: str, fn):
+    OP_REGISTRY[name] = fn
+    return fn
+
+
+def unwrap(x):
+    """Tensor → jax value; everything else passes through."""
+    from .tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def wrap_single(value, stop_gradient=True):
+    from .tensor import Tensor
+
+    return Tensor(value, stop_gradient=stop_gradient)
+
+
+def _check_nan_inf(name, flat_vals):
+    import numpy as np
+
+    for v in flat_vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+            if not isinstance(v, jax.core.Tracer):
+                if bool(jnp.any(~jnp.isfinite(v))):
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: op '{name}' produced NaN/Inf"
+                    )
+
+
+def apply(fn, *args, op_name: str = "", **kwargs):
+    """Run op ``fn(*args, **kwargs)`` with autograd recording.
+
+    ``args`` may contain Tensors (differentiable when
+    ``stop_gradient=False``), jax arrays, or python scalars; ``kwargs``
+    must be static (non-Tensor). Output mirrors ``fn``'s structure with
+    every array wrapped as a Tensor.
+    """
+    from .tensor import Tensor
+
+    vals = [unwrap(a) for a in args]
+    diff_idx = (
+        [
+            i
+            for i, a in enumerate(args)
+            if isinstance(a, Tensor)
+            and not a.stop_gradient
+            and jnp.issubdtype(jnp.asarray(a._value).dtype, jnp.inexact)
+        ]
+        if autograd.is_grad_enabled()
+        else []
+    )
+
+    if not diff_idx:
+        out = fn(*vals, **kwargs)
+        flat, treedef = jax.tree_util.tree_flatten(out)
+        if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+            _check_nan_inf(op_name or getattr(fn, "__name__", "op"), flat)
+        wrapped = [Tensor(v, stop_gradient=True) for v in flat]
+        return jax.tree_util.tree_unflatten(treedef, wrapped)
+
+    def closed(*diff_vals):
+        v = list(vals)
+        for i, dv in zip(diff_idx, diff_vals):
+            v[i] = dv
+        return fn(*v, **kwargs)
+
+    primals = tuple(vals[i] for i in diff_idx)
+    out, vjp_fn = jax.vjp(closed, *primals)
+    flat, treedef = jax.tree_util.tree_flatten(out)
+    if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+        _check_nan_inf(op_name or getattr(fn, "__name__", "op"), flat)
+
+    # Outputs with inexact dtype participate in grad; int outputs don't.
+    wrapped = [
+        Tensor(
+            v,
+            stop_gradient=not jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact),
+        )
+        for v in flat
+    ]
+    node = autograd.Node(
+        vjp_fn,
+        [args[i]._ensure_slot() for i in diff_idx],
+        [],
+        treedef,
+        name=op_name or getattr(fn, "__name__", "op"),
+    )
+    for t in wrapped:
+        slot = autograd.GradSlot(owner=t, node=node if not t.stop_gradient else None)
+        if not t.stop_gradient:
+            t._slot = slot
+        node.outputs.append(
+            (slot, tuple(jnp.shape(t._value)), jnp.asarray(t._value).dtype)
+        )
+    return jax.tree_util.tree_unflatten(treedef, wrapped)

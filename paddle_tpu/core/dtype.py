@@ -1,0 +1,178 @@
+"""Dtype model for paddle_tpu.
+
+Mirrors the reference's dtype surface (paddle.float32 etc.; reference:
+paddle/phi/common/data_type.h — unverified path, see SURVEY.md §0) on top of
+JAX/numpy dtypes. A ``DType`` is a thin, hashable wrapper around a canonical
+``jnp.dtype`` that stringifies the paddle way (``paddle.float32``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "DType",
+    "dtype",
+    "to_jax_dtype",
+    "to_paddle_dtype",
+    "get_default_dtype",
+    "set_default_dtype",
+    "finfo",
+    "iinfo",
+]
+
+
+class DType:
+    """A paddle-flavored dtype handle; interns one instance per name."""
+
+    _registry: dict[str, "DType"] = {}
+
+    def __new__(cls, name: str):
+        if name in cls._registry:
+            return cls._registry[name]
+        self = super().__new__(cls)
+        self._name = name
+        self._np = np.dtype(name)
+        cls._registry[name] = self
+        return self
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def numpy_dtype(self) -> np.dtype:
+        return self._np
+
+    def __repr__(self):
+        return f"paddle.{self._name}"
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return hash(self._name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self._name == other._name
+        try:
+            return np.dtype(_name_of(other)) == self._np
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self) -> bool:
+        return jnp.issubdtype(self._np, jnp.floating)
+
+    @property
+    def is_complex(self) -> bool:
+        return jnp.issubdtype(self._np, jnp.complexfloating)
+
+    @property
+    def is_integer(self) -> bool:
+        return jnp.issubdtype(self._np, jnp.integer)
+
+    @property
+    def itemsize(self) -> int:
+        return self._np.itemsize
+
+
+def _name_of(d) -> str:
+    """Normalize any dtype-ish object to a canonical string name."""
+    if isinstance(d, DType):
+        return d._name
+    if isinstance(d, str):
+        # paddle accepts "float32", "fp32" style aliases
+        aliases = {
+            "fp32": "float32",
+            "fp16": "float16",
+            "bf16": "bfloat16",
+            "fp64": "float64",
+        }
+        return aliases.get(d, d)
+    if d is float:
+        return "float32"
+    if d is int:
+        return "int64"
+    if d is bool:
+        return "bool"
+    return np.dtype(d).name
+
+
+# bfloat16 needs special-casing: np.dtype('bfloat16') works only because
+# ml_dtypes registers it (jax always ships ml_dtypes).
+bfloat16 = DType("bfloat16")
+float16 = DType("float16")
+float32 = DType("float32")
+float64 = DType("float64")
+int8 = DType("int8")
+int16 = DType("int16")
+int32 = DType("int32")
+int64 = DType("int64")
+uint8 = DType("uint8")
+uint16 = DType("uint16")
+uint32 = DType("uint32")
+uint64 = DType("uint64")
+bool_ = DType("bool")
+complex64 = DType("complex64")
+complex128 = DType("complex128")
+float8_e4m3fn = DType("float8_e4m3fn")
+float8_e5m2 = DType("float8_e5m2")
+
+
+def dtype(d) -> DType:
+    """Coerce to DType (paddle.dtype constructor analog)."""
+    return DType(_name_of(d))
+
+
+# With jax x64 disabled (the TPU-native default), 64-bit requests
+# canonicalize down — silently, the way jax itself canonicalizes, instead
+# of per-op truncation warnings. paddle's int64 indices become int32.
+_CANONICAL = {
+    "int64": "int32",
+    "uint64": "uint32",
+    "float64": "float32",
+    "complex128": "complex64",
+}
+
+
+def to_jax_dtype(d):
+    """DType/str/np.dtype → canonical jnp dtype (for use in jnp calls)."""
+    if d is None:
+        return None
+    name = _name_of(d)
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        name = _CANONICAL.get(name, name)
+    return jnp.dtype(name)
+
+
+def to_paddle_dtype(d) -> DType:
+    return DType(np.dtype(d).name)
+
+
+_default_dtype = float32
+
+
+def get_default_dtype() -> str:
+    """Matches paddle.get_default_dtype(): returns the string name."""
+    return _default_dtype.name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = dtype(d)
+    if not (d.is_floating_point or d.is_complex):
+        raise TypeError(
+            f"set_default_dtype only accepts floating dtypes, got {d}"
+        )
+    _default_dtype = d
+
+
+def finfo(d):
+    return jnp.finfo(to_jax_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(to_jax_dtype(d))

@@ -1,0 +1,72 @@
+"""FLAGS registry.
+
+The reference exposes ~200 gflags-style knobs settable via env (``FLAGS_*``)
+or ``paddle.set_flags()`` (reference: paddle/phi/core/flags.cc, pybind
+global_value_getter_setter — unverified, SURVEY.md §0). Here flags are a
+plain registry with env-var override at first read; unknown flags may be
+registered lazily so user code that sets vendor flags doesn't crash.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+__all__ = ["define_flag", "set_flags", "get_flags"]
+
+_FLAGS: dict[str, Any] = {}
+_HELP: dict[str, str] = {}
+
+
+def _coerce(value, like):
+    if isinstance(like, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(like, int) and not isinstance(like, bool):
+        return int(value)
+    if isinstance(like, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help: str = ""):
+    """Register a flag; env var of the same name wins over the default."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    value = default
+    if name in os.environ:
+        value = _coerce(os.environ[name], default)
+    _FLAGS[name] = value
+    _HELP[name] = help
+    return value
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags({'FLAGS_...': value})."""
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        if k in _FLAGS and _FLAGS[k] is not None:
+            v = _coerce(v, _FLAGS[k])
+        _FLAGS[k] = v
+
+
+def get_flags(flags) -> dict:
+    """paddle.get_flags('FLAGS_x') or (['FLAGS_x', ...])."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        out[k] = _FLAGS.get(k)
+    return out
+
+
+# Core flags (subset of the reference's set that has meaning here).
+define_flag("FLAGS_check_nan_inf", False, "per-op NaN/Inf scan in eager mode")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "accepted for compat; XLA manages memory")
+define_flag("FLAGS_use_pallas_kernels", True, "route hot ops to Pallas kernels on TPU")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "accepted for compat")
+define_flag("FLAGS_cudnn_deterministic", False, "accepted for compat; XLA is deterministic")
+define_flag("FLAGS_embedding_deterministic", False, "accepted for compat")

@@ -1,0 +1,378 @@
+"""The Tensor: paddle's imperative tensor on an immutable jax.Array.
+
+The reference's DenseTensor is buffer+meta (reference:
+paddle/phi/core/dense_tensor.h — unverified, SURVEY.md §0) with true
+in-place mutation; here "mutation" rebinds the wrapped immutable
+``jax.Array`` (functionalization), which preserves paddle semantics for
+every op while staying XLA-friendly. Tensor is registered as a jax pytree
+node, so jitted functions can take and return Tensors directly.
+
+Most op methods (``__add__``, ``.sum`` …) are monkey-patched onto this
+class by ``paddle_tpu.tensor`` — the same layering the reference uses
+(python/paddle/tensor/__init__.py patches methods onto the C++ tensor).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import DType, to_jax_dtype, to_paddle_dtype, get_default_dtype
+from .place import Place, current_place, device_for_place
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+def _coerce_value(data, dtype=None, place=None):
+    """data (array-like / Tensor / scalar) → jax.Array on the right device."""
+    if isinstance(data, Tensor):
+        data = data._value
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    if isinstance(data, jax.Array):
+        return data.astype(jdt) if jdt is not None and data.dtype != jdt else data
+    arr = np.asarray(data)
+    if jdt is None:
+        # paddle default promotion: python floats → default dtype;
+        # python ints → int64.
+        if arr.dtype == np.float64:
+            jdt = to_jax_dtype(get_default_dtype())
+        else:
+            jdt = arr.dtype
+    device = device_for_place(place)
+    return jax.device_put(arr.astype(jdt, copy=False), device)
+
+
+class Tensor:
+    """paddle.Tensor analog wrapping a jax.Array (or tracer)."""
+
+    __slots__ = (
+        "_value",
+        "_stop_gradient",
+        "_grad",
+        "_slot",
+        "_name",
+        "_grad_hooks",
+        "_retain_grad_flag",
+        "persistable",
+        "trainable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True, name=None):
+        self._value = _coerce_value(value, dtype, place)
+        self._stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._slot = None
+        self._name = name
+        self._grad_hooks = []
+        self._retain_grad_flag = False
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # -- meta ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self) -> DType:
+        return to_paddle_dtype(self._value.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    def numel(self):
+        return Tensor(jnp.asarray(self.size, dtype=jnp.int32))
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def place(self) -> Place:
+        return current_place()
+
+    @property
+    def name(self):
+        return self._name or f"tensor_{id(self):x}"
+
+    @name.setter
+    def name(self, v):
+        self._name = v
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    @property
+    def is_tensor(self):
+        return True
+
+    @property
+    def T(self):
+        from ..tensor.linalg import t
+
+        return t(self)
+
+    @property
+    def mT(self):
+        from ..tensor import manipulation as _m
+
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return _m.transpose(self, perm)
+
+    # -- grad ---------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    def _ensure_slot(self):
+        if self._slot is None:
+            self._slot = autograd.GradSlot(owner=self)
+        return self._slot
+
+    def is_leaf(self) -> bool:
+        return self._slot is None or self._slot.node is None
+
+    @property
+    def grad_fn(self):
+        return self._slot.node if self._slot is not None else None
+
+    def _set_grad_accum(self, g_value):
+        if self._grad is None:
+            self._grad = Tensor(g_value, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._value + g_value, stop_gradient=True)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._value))
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(h_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def retain_grads(self):
+        self._retain_grad_flag = True
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True)
+        t._name = self._name
+        return t
+
+    def detach_(self):
+        self._slot = None
+        self._stop_gradient = True
+        return self
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self._value))
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from .dispatch import apply
+
+        return apply(
+            lambda x: x.astype(to_jax_dtype(dtype)), self, op_name="cast"
+        )
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        from .dispatch import apply
+
+        return apply(lambda x: x + 0 if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.array(x), self, op_name="clone")
+
+    def to(self, *args, **kwargs):
+        """paddle Tensor.to(device|dtype|tensor)."""
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, Place)):
+                if isinstance(a, str) and a in DType._registry:
+                    out = out.astype(a)
+                else:
+                    pass  # single logical device space; placement is a no-op
+            elif isinstance(a, DType):
+                out = out.astype(a)
+            elif isinstance(a, Tensor):
+                out = out.astype(a.dtype)
+        return out
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- mutation (functional rebind) ---------------------------------------
+    def copy_(self, other, blocking=True):
+        other = other if isinstance(other, Tensor) else Tensor(other)
+        self._value = other._value.astype(self._value.dtype)
+        return self
+
+    def set_value(self, value):
+        v = _coerce_value(value, dtype=self.dtype)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {v.shape} vs {self._value.shape}"
+            )
+        self._value = v
+        return self
+
+    get_tensor = lambda self: self  # LoDTensor compat
+
+    def _rebind(self, new_tensor):
+        """Adopt another Tensor's value+version (in-place op epilogue).
+
+        Any previously recorded node keeps referencing this tensor's OLD
+        GradSlot — the old version stays a valid graph vertex while the
+        Python object moves on to the new version (see autograd.GradSlot).
+        """
+        import weakref as _wr
+
+        self._value = new_tensor._value
+        slot = new_tensor._slot
+        if slot is not None:
+            slot.owner_ref = _wr.ref(self)
+            self._stop_gradient = new_tensor._stop_gradient
+        self._slot = slot
+        return self
+
+    # -- protocol ------------------------------------------------------------
+    def __jax_array__(self):
+        return self._value
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.numpy().item(), spec)
+        return format(str(self), spec)
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._value)
+            body = np.array2string(val, precision=8, separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}, stop_gradient={self._stop_gradient},\n"
+            f"       {body})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    # math/compare dunders and op methods are patched by paddle_tpu.tensor
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle Parameter / EagerParamBase)."""
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# -- pytree registration -----------------------------------------------------
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (type(t), t._stop_gradient)
+
+
+def _tensor_unflatten(aux, children):
+    cls, stop_gradient = aux
+    obj = Tensor.__new__(cls)
+    obj._value = children[0]
+    obj._stop_gradient = stop_gradient
+    obj._grad = None
+    obj._slot = None
+    obj._name = None
+    obj._grad_hooks = []
+    obj._retain_grad_flag = False
+    obj.persistable = False
+    obj.trainable = not stop_gradient
+    return obj
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten)

@@ -1,0 +1,225 @@
+"""paddle_tpu.tensor — the op corpus, plus Tensor method patching.
+
+Mirrors the reference's layering: the op functions live in per-domain
+modules and are monkey-patched onto the Tensor class (reference:
+python/paddle/tensor/__init__.py does exactly this onto the C++ tensor —
+unverified, SURVEY.md §0).
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+
+# --------------------------------------------------------------------------
+# Indexing
+# --------------------------------------------------------------------------
+def _process_index(idx):
+    """Normalize a python index expression; Tensors → raw arrays."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    out = []
+    has_bool = False
+    for i in idx:
+        if isinstance(i, Tensor):
+            if i.dtype.name == "bool":
+                has_bool = True
+                out.append(np.asarray(jax.device_get(i._value)))
+            else:
+                out.append(i._value)
+        elif isinstance(i, np.ndarray) and i.dtype == bool:
+            has_bool = True
+            out.append(i)
+        else:
+            out.append(i)
+    return tuple(out), has_bool
+
+
+def _getitem(self, idx):
+    processed, has_bool = _process_index(idx)
+    return apply(lambda v: v[processed], self, op_name="getitem")
+
+
+def _setitem(self, idx, value):
+    processed, has_bool = _process_index(idx)
+    if isinstance(value, Tensor):
+        out = apply(
+            lambda v, u: v.at[processed].set(u.astype(v.dtype)),
+            self,
+            value,
+            op_name="setitem",
+        )
+    else:
+        out = apply(
+            lambda v: v.at[processed].set(value), self, op_name="setitem"
+        )
+    self._rebind(out)
+    return self
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# --------------------------------------------------------------------------
+# Operator dunders
+# --------------------------------------------------------------------------
+def _swap(fn):
+    return lambda self, other: fn(other, self)
+
+
+Tensor.__add__ = math.add
+Tensor.__radd__ = _swap(math.add)
+Tensor.__sub__ = math.subtract
+Tensor.__rsub__ = _swap(math.subtract)
+Tensor.__mul__ = math.multiply
+Tensor.__rmul__ = _swap(math.multiply)
+Tensor.__truediv__ = math.divide
+Tensor.__rtruediv__ = _swap(math.divide)
+Tensor.__floordiv__ = math.floor_divide
+Tensor.__rfloordiv__ = _swap(math.floor_divide)
+Tensor.__mod__ = math.mod
+Tensor.__rmod__ = _swap(math.mod)
+Tensor.__pow__ = math.pow
+Tensor.__rpow__ = _swap(math.pow)
+Tensor.__matmul__ = linalg.matmul
+Tensor.__rmatmul__ = _swap(linalg.matmul)
+Tensor.__neg__ = math.neg
+Tensor.__abs__ = math.abs
+# paddle's ~ is bitwise complement (logical only for bool, which
+# jnp.bitwise_not also handles correctly)
+Tensor.__invert__ = logic.bitwise_not
+Tensor.__eq__ = logic.equal
+Tensor.__ne__ = logic.not_equal
+Tensor.__lt__ = logic.less_than
+Tensor.__le__ = logic.less_equal
+Tensor.__gt__ = logic.greater_than
+Tensor.__ge__ = logic.greater_equal
+Tensor.__and__ = logic.bitwise_and
+Tensor.__or__ = logic.bitwise_or
+Tensor.__xor__ = logic.bitwise_xor
+
+
+def _iop(fn):
+    def op(self, other):
+        return self._rebind(fn(self, other))
+
+    return op
+
+
+Tensor.__iadd__ = _iop(math.add)
+Tensor.__isub__ = _iop(math.subtract)
+Tensor.__imul__ = _iop(math.multiply)
+Tensor.__itruediv__ = _iop(math.divide)
+
+
+# --------------------------------------------------------------------------
+# Method patching
+# --------------------------------------------------------------------------
+_METHOD_SOURCES = [math, creation, manipulation, linalg, logic, random, search, stat]
+_SKIP = {"to_tensor", "is_tensor", "meshgrid", "tril_indices", "triu_indices",
+         "rand", "randn", "randint", "uniform", "normal", "randperm", "arange",
+         "linspace", "logspace", "eye", "zeros", "ones", "full", "empty",
+         "complex", "polar", "assign", "broadcast_tensors"}
+
+def _public_ops(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [
+            n
+            for n in dir(mod)
+            if not n.startswith("_")
+            and callable(getattr(mod, n))
+            and getattr(getattr(mod, n), "__module__", "").startswith("paddle_tpu")
+        ]
+    return names
+
+
+for _mod in _METHOD_SOURCES:
+    for _name in _public_ops(_mod):
+        if _name in _SKIP or hasattr(Tensor, _name):
+            continue
+        _fn = getattr(_mod, _name)
+        if callable(_fn):
+            setattr(Tensor, _name, _fn)
+
+# In-place variants: x.op_() rebinds the buffer (paddle inplace API).
+_INPLACE = {
+    "add_": math.add, "subtract_": math.subtract, "multiply_": math.multiply,
+    "divide_": math.divide, "clip_": math.clip, "scale_": math.scale,
+    "exp_": math.exp, "sqrt_": math.sqrt, "rsqrt_": math.rsqrt,
+    "abs_": math.abs, "ceil_": math.ceil, "floor_": math.floor,
+    "round_": math.round, "reciprocal_": math.reciprocal, "neg_": math.neg,
+    "tanh_": math.tanh, "sigmoid_": math.sigmoid, "pow_": math.pow,
+    "remainder_": math.remainder, "mod_": math.mod,
+}
+for _name, _fn in _INPLACE.items():
+    def _make(_fn):
+        def op(self, *args, **kwargs):
+            return self._rebind(_fn(self, *args, **kwargs))
+
+        return op
+
+    if not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _make(_fn))
+
+
+def _fill_(self, value):
+    self._value = jnp.full_like(self._value, value)
+    return self
+
+
+def _zero_(self):
+    self._value = jnp.zeros_like(self._value)
+    return self
+
+
+Tensor.fill_ = _fill_
+Tensor.zero_ = _zero_
+
+
+def _fill_diagonal_(self, value, offset=0, wrap=False, name=None):
+    nrow, ncol = self.shape[-2], self.shape[-1]
+    if wrap and self.ndim == 2 and nrow > ncol:
+        # numpy fill_diagonal wrap semantics: the diagonal restarts every
+        # ncol+1 flat positions down the tall matrix
+        flat = np.arange(offset, nrow * ncol, ncol + 1)
+        rr, cc = flat // ncol, flat % ncol
+    else:
+        r = np.arange(nrow)
+        rr = r[(r + offset >= 0) & (r + offset < ncol)]
+        cc = rr + offset
+    idx = (jnp.asarray(rr), jnp.asarray(cc))
+    return self._rebind(
+        apply(
+            lambda v: v.at[(..., *idx)].set(value), self, op_name="fill_diagonal_"
+        )
+    )
+
+
+Tensor.fill_diagonal_ = _fill_diagonal_
+
+# paddle aliases
+Tensor.multiply_ = Tensor.multiply_
+Tensor.mm = linalg.mm
+Tensor.matmul = linalg.matmul
+Tensor.dot = linalg.dot
+Tensor.norm = linalg.norm
+Tensor.dist = linalg.dist
+Tensor.cholesky = linalg.cholesky
+Tensor.inverse = linalg.inv

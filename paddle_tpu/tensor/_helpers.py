@@ -1,0 +1,27 @@
+"""Shared helpers for the op corpus."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..core.dtype import to_jax_dtype
+
+__all__ = ["Tensor", "apply", "to_jax_dtype", "ensure_tensor", "axes_arg"]
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def axes_arg(axis):
+    """Normalize paddle axis arg (int | list | tuple | Tensor | None)."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)

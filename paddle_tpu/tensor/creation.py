@@ -1,0 +1,199 @@
+"""Creation ops (reference surface: python/paddle/tensor/creation.py —
+unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, to_jax_dtype
+from ..core.dtype import get_default_dtype
+from ..core.tensor import to_tensor  # re-export  # noqa: F401
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar", "one_hot",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(
+        int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or get_default_dtype()
+    return to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_arg(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_arg(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = get_default_dtype()  # paddle full defaults float
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape_arg(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, dtype=to_jax_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._value, dtype=to_jax_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=to_jax_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = get_default_dtype()
+        else:
+            dtype = "int64"
+    return Tensor(jnp.arange(start, end, step, dtype=to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+
+    return Tensor(
+        jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_dt(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+
+    return Tensor(
+        jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(v, offset=offset)
+
+    return apply(fn, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(
+        lambda v: jnp.diagflat(v, k=offset), ensure_tensor(x), op_name="diagflat"
+    )
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), ensure_tensor(x), op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), ensure_tensor(x), op_name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col or row)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    ts = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *ts, op_name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, float, int)) else Tensor(np.asarray(x))
+    out = apply(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.inexact) else jnp.asarray(v), x, op_name="assign")
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def complex(real, imag, name=None):
+    return apply(
+        lambda r, i: jax.lax.complex(r, i),
+        ensure_tensor(real),
+        ensure_tensor(imag),
+        op_name="complex",
+    )
+
+
+def polar(abs, angle, name=None):
+    return apply(
+        lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+        ensure_tensor(abs),
+        ensure_tensor(angle),
+        op_name="polar",
+    )
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=to_jax_dtype(get_default_dtype())),
+        ensure_tensor(x),
+        op_name="one_hot",
+    )

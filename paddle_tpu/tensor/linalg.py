@@ -1,0 +1,310 @@
+"""Linear algebra ops (reference surface: python/paddle/tensor/linalg.py —
+unverified, SURVEY.md §0). matmuls carry ``preferred_element_type=float32``
+under bf16 inputs so the MXU accumulates in fp32."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, to_jax_dtype
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose_last", "norm", "dist",
+    "cross", "cholesky", "inv", "pinv", "det", "slogdet", "solve",
+    "triangular_solve", "cholesky_solve", "svd", "qr", "eig", "eigh",
+    "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "mv",
+    "histogram", "bincount", "corrcoef", "cov", "lstsq", "lu", "multi_dot",
+    "einsum",
+]
+
+
+def _mm(a, b):
+    pet = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(a, b, preferred_element_type=pet)
+    return out.astype(a.dtype) if pet is not None else out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return _mm(a, b)
+
+    return apply(fn, x, y, op_name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return apply(_mm, ensure_tensor(x), ensure_tensor(vec), op_name="mv")
+
+
+def dot(x, y, name=None):
+    return apply(
+        lambda a, b: jnp.sum(a * b, axis=-1), ensure_tensor(x), ensure_tensor(y),
+        op_name="dot",
+    )
+
+
+def t(input, name=None):
+    x = ensure_tensor(input)
+    if x.ndim > 2:
+        raise ValueError("paddle.t expects ndim <= 2")
+    return apply(lambda v: v.T, x, op_name="t")
+
+
+def transpose_last(x):
+    return apply(lambda v: jnp.swapaxes(v, -1, -2), ensure_tensor(x), op_name="transpose_last")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if p is None:
+        p = "fro" if (axis is None or isinstance(axis, (list, tuple))) else 2
+
+    def fn(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(v.dtype))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        return jnp.linalg.norm(v, ord=p, axis=ax, keepdims=keepdim)
+
+    return apply(fn, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(ensure_tensor(x) - ensure_tensor(y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis
+    if ax == 9:  # paddle default: first axis of size 3
+        ax = next(i for i, s in enumerate(x.shape) if s == 3)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), x, y, op_name="cross")
+
+
+def _linalg_unary(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, ensure_tensor(x), op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+cholesky_fn = lambda v, upper: jnp.linalg.cholesky(v) if not upper else jnp.swapaxes(jnp.linalg.cholesky(v), -1, -2).conj()
+
+
+def cholesky(x, upper=False, name=None):
+    return apply(lambda v: cholesky_fn(v, upper), ensure_tensor(x), op_name="cholesky")
+
+
+inv = _linalg_unary(jnp.linalg.inv, "inv")
+det = _linalg_unary(jnp.linalg.det, "det")
+
+
+def slogdet(x, name=None):
+    out = apply(
+        lambda v: tuple(jnp.linalg.slogdet(v)), ensure_tensor(x), op_name="slogdet"
+    )
+    return out
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(
+        lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+        ensure_tensor(x),
+        op_name="pinv",
+    )
+
+
+def solve(x, y, name=None):
+    return apply(
+        lambda a, b: jnp.linalg.solve(a, b), ensure_tensor(x), ensure_tensor(y),
+        op_name="solve",
+    )
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        ),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        op_name="triangular_solve",
+    )
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply(
+        lambda b, L: jax.scipy.linalg.cho_solve((L, not upper), b),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        op_name="cholesky_solve",
+    )
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+        ensure_tensor(x),
+        op_name="svd",
+    )
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(
+        lambda v: tuple(jnp.linalg.qr(v, mode=mode)),
+        ensure_tensor(x),
+        op_name="qr",
+    )
+
+
+def eig(x, name=None):
+    return apply(
+        lambda v: tuple(jnp.linalg.eig(v)), ensure_tensor(x), op_name="eig"
+    )
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(
+        lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)),
+        ensure_tensor(x),
+        op_name="eigh",
+    )
+
+
+def eigvals(x, name=None):
+    return apply(lambda v: jnp.linalg.eigvals(v), ensure_tensor(x), op_name="eigvals")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(
+        lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), ensure_tensor(x),
+        op_name="eigvalsh",
+    )
+
+
+def matrix_power(x, n, name=None):
+    return apply(
+        lambda v: jnp.linalg.matrix_power(v, n), ensure_tensor(x),
+        op_name="matrix_power",
+    )
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(
+        lambda v: jnp.linalg.matrix_rank(v, rtol=tol),
+        ensure_tensor(x),
+        op_name="matrix_rank",
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    x = ensure_tensor(input)
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        import numpy as np
+
+        v = x.numpy()
+        lo, hi = float(v.min()), float(v.max())
+
+    def fn(v):
+        if weight is not None or density:
+            w = weight._value if isinstance(weight, Tensor) else weight
+            h, _ = jnp.histogram(
+                v.reshape(-1), bins=bins, range=(lo, hi),
+                weights=None if w is None else jnp.reshape(w, (-1,)),
+                density=density,
+            )
+            return h
+        h, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))
+        return h.astype(jnp.int32)
+
+    return apply(fn, x, op_name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+
+    n = int(np.maximum(np.asarray(x.numpy()).max(initial=-1) + 1, minlength))
+    if weights is not None:
+        return apply(
+            lambda v, w: jnp.bincount(v.reshape(-1), w.reshape(-1), length=n),
+            x, ensure_tensor(weights), op_name="bincount",
+        )
+    return apply(
+        lambda v: jnp.bincount(v.reshape(-1), length=n), x, op_name="bincount"
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(
+        lambda v: jnp.corrcoef(v, rowvar=rowvar), ensure_tensor(x), op_name="corrcoef"
+    )
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(
+        lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0),
+        ensure_tensor(x),
+        op_name="cov",
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply(fn, ensure_tensor(x), ensure_tensor(y), op_name="lstsq")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    out = apply(fn, ensure_tensor(x), op_name="lu")
+    if get_infos:
+        import jax.numpy as _j
+
+        return out[0], out[1], Tensor(_j.zeros((), _j.int32))
+    return out
+
+
+def multi_dot(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *ts, op_name="multi_dot")
+
+
+def einsum(equation, *operands):
+    ts = [ensure_tensor(t) for t in operands]
+    return apply(
+        lambda *vs: jnp.einsum(equation, *vs), *ts, op_name="einsum"
+    )

@@ -1,0 +1,71 @@
+"""Comparison / logical ops (reference surface:
+python/paddle/tensor/logic.py — unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "is_tensor",
+    "where",
+]
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        xt = x if isinstance(x, (int, float, bool, complex)) else ensure_tensor(x)
+        yt = y if isinstance(y, (int, float, bool, complex)) else ensure_tensor(y)
+        return apply(jfn, xt, yt, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, ensure_tensor(x), op_name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, ensure_tensor(x), op_name="bitwise_not")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        # paddle.where(cond) == nonzero(cond, as_tuple=True)
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    xt = x if isinstance(x, (int, float, bool)) else ensure_tensor(x)
+    yt = y if isinstance(y, (int, float, bool)) else ensure_tensor(y)
+    return apply(
+        lambda c, a, b: jnp.where(c, a, b), condition, xt, yt, op_name="where"
+    )

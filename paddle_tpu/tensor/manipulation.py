@@ -1,0 +1,573 @@
+"""Shape/layout manipulation ops (reference surface:
+python/paddle/tensor/manipulation.py — unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, axes_arg, to_jax_dtype
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "concat", "stack", "split", "chunk", "flatten", "flip",
+    "roll", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "take_along_axis", "put_along_axis", "unbind",
+    "repeat_interleave", "cast", "slice", "strided_slice", "unique",
+    "unique_consecutive", "rot90", "as_complex", "as_real", "moveaxis",
+    "unstack", "unfold", "view", "view_as", "atleast_1d", "atleast_2d",
+    "atleast_3d", "diagonal", "crop", "pad",
+]
+
+
+def _resolve_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    return tuple(
+        int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = _resolve_shape(shape)
+    # paddle semantics: 0 means "copy this input dim"
+    shp = tuple(
+        x.shape[i] if s == 0 and i < x.ndim else s for i, s in enumerate(shp)
+    )
+    return apply(lambda v: jnp.reshape(v, shp), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), ensure_tensor(x), op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(
+        lambda v: jnp.moveaxis(v, source, destination),
+        ensure_tensor(x),
+        op_name="moveaxis",
+    )
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+
+    def fn(v):
+        if ax is None:
+            return jnp.squeeze(v)
+        axes = (ax,) if isinstance(ax, int) else ax
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply(fn, x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    axes = (ax,) if isinstance(ax, int) else ax
+    return apply(lambda v: jnp.expand_dims(v, axes), x, op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *ts, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=int(axis)), *ts, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} along axis {ax} is not divisible "
+                f"by num_or_sections={num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if -1 in sizes:
+            rem = dim - sum(s for s in sizes if s != -1)
+            sizes[sizes.index(-1)] = rem
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def fn(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, int(o), int(o + s), axis=ax)
+            for o, s in zip(offsets, sizes)
+        )
+
+    return list(apply(fn, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0):
+    x = ensure_tensor(input)
+    n = x.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(apply(fn, x, op_name="unbind"))
+
+
+unstack = unbind
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+
+    def fn(v):
+        shape = v.shape[:sa] + (-1,) + v.shape[ea + 1 :]
+        return v.reshape(shape)
+
+    return apply(fn, x, op_name="flatten")
+
+
+def flip(x, axis, name=None):
+    ax = axes_arg(axis)
+    return apply(lambda v: jnp.flip(v, axis=ax), ensure_tensor(x), op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), ensure_tensor(x), op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = axes_arg(shifts)
+    ax = axes_arg(axis)
+    return apply(lambda v: jnp.roll(v, sh, axis=ax), ensure_tensor(x), op_name="roll")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _resolve_shape(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), ensure_tensor(x), op_name="tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = _resolve_shape(shape)
+    shp = tuple(
+        x.shape[i - (len(shp) - x.ndim)] if s == -1 else s
+        for i, s in enumerate(shp)
+    )
+    return apply(lambda v: jnp.broadcast_to(v, shp), x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(
+        lambda v: jnp.broadcast_to(v, _resolve_shape(shape)),
+        ensure_tensor(x),
+        op_name="broadcast_to",
+    )
+
+
+def broadcast_tensors(input, name=None):
+    ts = [ensure_tensor(t) for t in input]
+    return list(apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts, op_name="broadcast_tensors"))
+
+
+def cast(x, dtype):
+    return ensure_tensor(x).astype(dtype)
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(
+        lambda v, i: jnp.take(v, i.reshape(-1).astype(jnp.int32), axis=ax),
+        x,
+        index,
+        op_name="gather",
+    )
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def fn(v, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return v[flat_idx]
+
+    return apply(fn, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def fn(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle overwrite=False: zero destination rows then scatter-add
+        zeroed = v.at[i].set(0.0)
+        return zeroed.at[i].add(u)
+
+    return apply(fn, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shp = _resolve_shape(shape)
+
+    def fn(i, u):
+        zeros = jnp.zeros(shp, u.dtype)
+        k = i.shape[-1]
+        idx = tuple(i[..., d] for d in range(k))
+        return zeros.at[idx].add(u)
+
+    return apply(fn, index, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def fn(v, i, u):
+        k = i.shape[-1]
+        idx = tuple(i[..., d] for d in range(k))
+        return v.at[idx].add(u)
+
+    return apply(fn, x, index, updates, op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(
+        lambda v, i: jnp.take(v, i.reshape(-1).astype(jnp.int32), axis=int(axis)),
+        ensure_tensor(x),
+        ensure_tensor(index),
+        op_name="index_select",
+    )
+
+
+def index_sample(x, index):
+    return apply(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+        ensure_tensor(x),
+        ensure_tensor(index),
+        op_name="index_sample",
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def fn(v, i, u):
+        idx = i.reshape(-1).astype(jnp.int32)
+        sl = [slice(None)] * v.ndim
+        sl[axis] = idx
+        return v.at[tuple(sl)].add(u)
+
+    return apply(fn, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    idx_ts = [ensure_tensor(i) for i in indices]
+    value = ensure_tensor(value)
+
+    def fn(v, u, *idxs):
+        key = tuple(i for i in idxs)
+        if accumulate:
+            return v.at[key].add(u)
+        return v.at[key].set(u)
+
+    return apply(fn, x, value, *idx_ts, op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    """Data-dependent output shape: eager-only (not jittable), like the
+    reference op which allocates dynamically on host sync."""
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    xv = np.asarray(jax.device_get(x._value))
+    mv = np.asarray(jax.device_get(mask._value))
+    mv = np.broadcast_to(mv, xv.shape)
+    n = int(mv.sum())
+    flat_idx = np.nonzero(mv.reshape(-1))[0]
+
+    def fn(v):
+        return jnp.take(v.reshape(-1), jnp.asarray(flat_idx))
+
+    return apply(fn, x, op_name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(value, Tensor):
+        return apply(
+            lambda v, m, val: jnp.where(m, val.astype(v.dtype), v),
+            x, mask, value, op_name="masked_fill",
+        )
+    return apply(
+        lambda v, m: jnp.where(m, jnp.asarray(value, v.dtype), v),
+        x, mask, op_name="masked_fill",
+    )
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+        ensure_tensor(arr),
+        ensure_tensor(indices),
+        op_name="take_along_axis",
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values, arr._value.dtype))
+
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amax": "max", "amin": "min"}[reduce]
+        dnums = None
+        # build with .at on a take_along trick: construct open indices
+        idx = [jnp.broadcast_to(
+            jnp.arange(v.shape[d]).reshape([-1 if dd == d else 1 for dd in range(v.ndim)]),
+            i.shape) for d in range(v.ndim)]
+        idx[axis] = i
+        at = v.at[tuple(idx)]
+        return {"add": at.add, "multiply": at.multiply, "max": at.max, "min": at.min}[mode](u)
+
+    return apply(fn, arr, indices, values, op_name="put_along_axis")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.numpy())
+        total = int(reps.sum())
+        return apply(
+            lambda v, r: jnp.repeat(v, r, axis=axis if axis is not None else None, total_repeat_length=total),
+            x, repeats, op_name="repeat_interleave",
+        )
+    return apply(
+        lambda v: jnp.repeat(v, int(repeats), axis=axis),
+        x, op_name="repeat_interleave",
+    )
+
+
+def slice(input, axes, starts, ends):
+    x = ensure_tensor(input)
+
+    def _v(s):
+        return int(s.item()) if isinstance(s, Tensor) else int(s)
+
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[int(ax)] = builtins.slice(_v(st), _v(en))
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, op_name="strided_slice")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    """Eager-only (dynamic output shape)."""
+    x = ensure_tensor(x)
+    xv = np.asarray(jax.device_get(x._value))
+    res = np.unique(xv, return_index=True, return_inverse=True, return_counts=True, axis=axis)
+    vals, idx, inv, counts = res
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(idx).astype(to_jax_dtype(dtype))))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv).astype(to_jax_dtype(dtype))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts).astype(to_jax_dtype(dtype))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    xv = np.asarray(jax.device_get(x._value))
+    if axis is None:
+        xv = xv.reshape(-1)
+        keep = np.ones(len(xv), dtype=bool)
+        keep[1:] = xv[1:] != xv[:-1]
+        vals = xv[keep]
+        inv = np.cumsum(keep) - 1
+        counts = np.diff(np.append(np.nonzero(keep)[0], len(xv)))
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv).astype(to_jax_dtype(dtype))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts).astype(to_jax_dtype(dtype))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_complex(x, name=None):
+    return apply(
+        lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+        ensure_tensor(x),
+        op_name="as_complex",
+    )
+
+
+def as_real(x, name=None):
+    return apply(
+        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+        ensure_tensor(x),
+        op_name="as_real",
+    )
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return ensure_tensor(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, ensure_tensor(t), op_name="atleast_1d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, ensure_tensor(t), op_name="atleast_2d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, ensure_tensor(t), op_name="atleast_3d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+        ensure_tensor(x),
+        op_name="diagonal",
+    )
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = _resolve_shape(shape)
+    offs = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    shp = tuple(x.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp))
+
+    def fn(v):
+        return jax.lax.dynamic_slice(v, offs, shp)
+
+    return apply(fn, x, op_name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics (also exported at tensor level)."""
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank paddle format: per-dim (before, after), dim order ascending
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # NCHW-style: pad applies to last len(pad)//2 spatial dims, reversed
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC / NDHWC: spatial before channel
+            spatial_dims = list(range(1, 1 + n_spatial))
+        else:
+            spatial_dims = list(range(nd - n_spatial, nd))
+        for i, d in enumerate(spatial_dims):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def fn(v):
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply(fn, x, op_name="pad")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle.nn.functional.unfold): NCHW → (N, C*kh*kw, L)."""
+    x = ensure_tensor(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, int):
+        pt = pb = pl_ = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl_ = pr = paddings[1]
+    else:
+        pt, pl_, pb, pr = paddings
+
+    def fn(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), [(pt, pb), (pl_, pr)],
+            rhs_dilation=(dh, dw), dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        # patches: (N, C*kh*kw, oh, ow)
+        return patches.reshape(n, c * kh * kw, -1)
+
+    return apply(fn, x, op_name="unfold")

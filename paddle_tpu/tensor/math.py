@@ -1,0 +1,439 @@
+"""Math ops (reference surface: python/paddle/tensor/math.py — unverified,
+SURVEY.md §0). Every op routes through the dispatch seam so autograd and
+jit tracing come for free; numerics follow jnp (TPU-native) with
+paddle-style signatures (``axis``/``keepdim`` naming, broadcasting incl.
+0-D tensors).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, axes_arg, to_jax_dtype
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, ensure_tensor(x), op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _binary(jfn, name):
+    def op(x, y, name=None):
+        # python scalars stay raw so jnp weak-typing keeps the tensor dtype
+        xt = x if isinstance(x, (int, float, bool, complex)) else ensure_tensor(x)
+        yt = y if isinstance(y, (int, float, bool, complex)) else ensure_tensor(y)
+        return apply(jfn, xt, yt, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary -------------------------------------------------------
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(lambda x: jax.lax.rsqrt(x), "rsqrt")
+square = _unary(jnp.square, "square")
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+sign = _unary(jnp.sign, "sign")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda x: x - jnp.trunc(x), "frac")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.lax.erf, "erf")
+erfinv = _unary(jax.lax.erf_inv, "erfinv")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+reciprocal = _unary(lambda x: 1.0 / x, "reciprocal")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+i0 = _unary(lambda x: jax.scipy.special.i0(x), "i0")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+logit = _unary(jax.scipy.special.logit, "logit")
+
+
+def rsqrt_(x):
+    return x._rebind(rsqrt(x))
+
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+mod = _binary(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+heaviside = _binary(jnp.heaviside, "heaviside")
+copysign = _binary(jnp.copysign, "copysign")
+nextafter = _binary(jnp.nextafter, "nextafter")
+ldexp = _binary(jnp.ldexp, "ldexp")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+inner = _binary(jnp.inner, "inner")
+outer = _binary(jnp.outer, "outer")
+kron = _binary(jnp.kron, "kron")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s = float(scale) if not isinstance(scale, Tensor) else scale
+
+    def fn(v, sv=None):
+        sval = sv if sv is not None else s
+        if bias_after_scale:
+            out = v * sval + bias
+        else:
+            out = (v + bias) * sval
+        return out
+
+    if isinstance(s, Tensor):
+        return apply(lambda v, sv: fn(v, sv), x, s, op_name="scale")
+    return apply(fn, x, op_name="scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply(lambda v: jnp.clip(v, lo, hi), x, op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def fn(i, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        sel = i.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(xs[0].shape[0])]
+
+    return apply(fn, idx, *ts, op_name="multiplex")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+        ensure_tensor(x),
+        op_name="nan_to_num",
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(
+        lambda v: scale_b * jnp.tanh(scale_a * v), ensure_tensor(x), op_name="stanh"
+    )
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        ensure_tensor(input),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        op_name="addmm",
+    )
+
+
+# -- reductions --------------------------------------------------------------
+def _reduce(jfn, name, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = ensure_tensor(x)
+        ax = axes_arg(axis)
+        jdt = to_jax_dtype(dtype) if dtype is not None else None
+
+        def fn(v):
+            out = jfn(v, axis=ax, keepdims=keepdim)
+            if jdt is not None:
+                out = out.astype(jdt)
+            elif int_promote and jnp.issubdtype(v.dtype, jnp.integer):
+                out = out.astype(jnp.int32)
+            return out
+
+        return apply(fn, x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum", int_promote=True)
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod", int_promote=True)
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.max(v, axis=axes_arg(axis), keepdims=keepdim),
+        ensure_tensor(x),
+        op_name="max",
+    )
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.min(v, axis=axes_arg(axis), keepdims=keepdim),
+        ensure_tensor(x),
+        op_name="min",
+    )
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jax.scipy.special.logsumexp(v, axis=axes_arg(axis), keepdims=keepdim),
+        ensure_tensor(x),
+        op_name="logsumexp",
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jdt = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        out = jnp.cumsum(v, axis=ax)
+        return out.astype(jdt) if jdt else out
+
+    return apply(fn, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jdt = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        out = jnp.cumprod(v, axis=int(dim))
+        return out.astype(jdt) if jdt else out
+
+    return apply(fn, x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        return jax.lax.associative_scan(jnp.maximum, v, axis=ax)
+
+    vals = apply(fn, x, op_name="cummax")
+    # indices: first occurrence of running max
+    def idx_fn(v):
+        if axis is None:
+            v2 = v.reshape(-1)
+            ax = 0
+        else:
+            v2, ax = v, int(axis)
+        run = jax.lax.associative_scan(jnp.maximum, v2, axis=ax)
+        ar = jnp.arange(v2.shape[ax]).reshape(
+            [-1 if i == ax else 1 for i in range(v2.ndim)]
+        )
+        cand = jnp.where(v2 == run, ar, -1)
+        idx = jax.lax.associative_scan(jnp.maximum, cand, axis=ax)
+        return idx.astype(to_jax_dtype(dtype))
+
+    idx = apply(idx_fn, x.detach(), op_name="cummax_idx")
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    nx = neg(ensure_tensor(x))
+    vals, idx = cummax(nx, axis=axis, dtype=dtype)
+    return neg(vals), idx
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.count_nonzero(v, axis=axes_arg(axis), keepdims=keepdim).astype(
+            jnp.int32
+        ),
+        ensure_tensor(x),
+        op_name="count_nonzero",
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.all(v, axis=axes_arg(axis), keepdims=keepdim),
+        ensure_tensor(x),
+        op_name="all",
+    )
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.any(v, axis=axes_arg(axis), keepdims=keepdim),
+        ensure_tensor(x),
+        op_name="any",
+    )
+
+
+# -- tests -------------------------------------------------------------------
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return apply(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        op_name="isclose",
+    )
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return apply(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        op_name="allclose",
+    )
+
+
+def equal_all(x, y, name=None):
+    return apply(
+        lambda a, b: jnp.array_equal(a, b),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        op_name="equal_all",
+    )
+
+
+# -- misc --------------------------------------------------------------------
+def increment(x, value=1.0, name=None):
+    return x._rebind(apply(lambda v: v + value, x, op_name="increment"))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [ensure_tensor(x)]
+    pre = ensure_tensor(prepend) if prepend is not None else None
+    app = ensure_tensor(append) if append is not None else None
+
+    def fn(v, *rest):
+        i = 0
+        p = a = None
+        if pre is not None:
+            p = rest[i]
+            i += 1
+        if app is not None:
+            a = rest[i]
+        return jnp.diff(v, n=n, axis=axis, prepend=p, append=a)
+
+    if pre is not None:
+        args.append(pre)
+    if app is not None:
+        args.append(app)
+    return apply(fn, *args, op_name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        return apply(
+            lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
+            y,
+            ensure_tensor(x),
+            op_name="trapezoid",
+        )
+    return apply(
+        lambda yy: jax.scipy.integrate.trapezoid(yy, dx=dx or 1.0, axis=axis),
+        y,
+        op_name="trapezoid",
+    )
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if mode == "raise":
+        # Out-of-range check is host-side (eager); inside jit we clip, the
+        # same compromise the reference's GPU kernels make for 'raise'.
+        import jax as _jax
+        import numpy as _np
+
+        if not isinstance(index._value, _jax.core.Tracer):
+            idx = _np.asarray(_jax.device_get(index._value))
+            n = x.size
+            if idx.size and (idx.max() >= n or idx.min() < -n):
+                raise IndexError(
+                    f"take: index out of range for tensor with {n} elements"
+                )
+        jmode = "clip"
+    else:
+        jmode = {"clip": "clip", "wrap": "wrap"}[mode]
+    return apply(
+        lambda v, i: jnp.take(v.reshape(-1), i.reshape(-1), mode=jmode).reshape(i.shape),
+        x,
+        index,
+        op_name="take",
+    )
+
+
+# __all__ is assembled from the ops defined in this module so star-imports
+# and Tensor method patching never leak helpers (jax/jnp/Tensor/apply...).
+__all__ = [
+    n
+    for n, v in list(globals().items())
+    if not n.startswith("_")
+    and callable(v)
+    and getattr(v, "__module__", None) == __name__
+]

@@ -1,0 +1,183 @@
+"""Random ops threaded off the global Generator (see core/random.py).
+
+Reference surface: python/paddle/tensor/random.py — unverified, SURVEY.md
+§0. Each call draws a fresh fold_in key, so eager sequences after
+``paddle.seed`` are deterministic; the key is captured by value in the op
+closure, so autograd replays are stable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, to_jax_dtype
+from ..core.dtype import get_default_dtype
+from ..core.random import next_key
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "uniform_",
+    "normal", "normal_", "standard_normal", "randperm", "multinomial",
+    "bernoulli", "poisson", "exponential_", "rand_like", "randn_like",
+    "gumbel_softmax",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dt))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dt))
+
+
+standard_normal = randn
+
+
+def rand_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype) or x._value.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = to_jax_dtype(dtype) or x._value.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape), dt))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(next_key(), _shape(shape), int(low), int(high)).astype(
+            to_jax_dtype(dtype)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    dt = to_jax_dtype(dtype) or x._value.dtype
+    return Tensor(
+        jax.random.randint(next_key(), tuple(x.shape), int(low), int(high)).astype(dt)
+    )
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), dt, minval=float(min), maxval=float(max))
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(tuple(x.shape), x.dtype, min, max, seed)
+    x._value = out._value
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean) if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std) if isinstance(std, Tensor) else std
+        shp = tuple((m if isinstance(m, Tensor) else s).shape)
+        key = next_key()
+        z = jax.random.normal(key, shp, to_jax_dtype(get_default_dtype()))
+        mm = m if isinstance(m, (int, float)) else m
+        ss = s if isinstance(s, (int, float)) else s
+        args = [t for t in (mm, ss) if isinstance(t, Tensor)]
+
+        def fn(*vs):
+            i = 0
+            mv = mm if isinstance(mm, (int, float)) else vs[0]
+            if not isinstance(mm, (int, float)):
+                i = 1
+            sv = ss if isinstance(ss, (int, float)) else vs[i]
+            return mv + sv * z
+
+        return apply(fn, *args, op_name="normal")
+    shp = _shape(shape if shape is not None else (1,))
+    return Tensor(
+        mean + std * jax.random.normal(next_key(), shp, to_jax_dtype(get_default_dtype()))
+    )
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    z = jax.random.normal(next_key(), tuple(x.shape), x._value.dtype)
+    x._value = (mean + std * z).astype(x._value.dtype)
+    return x
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(to_jax_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = next_key()
+
+    def fn(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1, shape=(*v.shape[:-1], num_samples)
+            ).astype(jnp.int32)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, v.shape, jnp.float32)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int32)
+
+    return apply(fn, x, op_name="multinomial")
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = next_key()
+    return apply(
+        lambda v: jax.random.bernoulli(key, v).astype(v.dtype), x, op_name="bernoulli"
+    )
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = next_key()
+    return apply(
+        lambda v: jax.random.poisson(key, v).astype(v.dtype), x, op_name="poisson"
+    )
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(next_key(), tuple(x.shape), x._value.dtype)
+    x._value = (-jnp.log1p(-u) / lam).astype(x._value.dtype)
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    key = next_key()
+
+    def fn(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through: hard value forward, soft gradient backward
+            y = y_hard + (y - jax.lax.stop_gradient(y))
+        return y
+
+    return apply(fn, x, op_name="gumbel_softmax")

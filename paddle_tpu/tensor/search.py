@@ -1,0 +1,199 @@
+"""Search/sort ops (reference surface: python/paddle/tensor/search.py —
+unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, to_jax_dtype
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "index_select",
+    "masked_select", "searchsorted", "kthvalue", "mode", "median",
+    "nanmedian", "quantile", "nanquantile", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim if keepdim else ()).astype(to_jax_dtype(dtype))
+        out = jnp.argmax(v, axis=int(axis), keepdims=keepdim)
+        return out.astype(to_jax_dtype(dtype))
+
+    return apply(fn, x, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim if keepdim else ()).astype(to_jax_dtype(dtype))
+        return jnp.argmin(v, axis=int(axis), keepdims=keepdim).astype(to_jax_dtype(dtype))
+
+    return apply(fn, x, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    return apply(
+        lambda v: jnp.argsort(
+            -v if descending else v, axis=int(axis), stable=stable
+        ).astype(jnp.int32),
+        ensure_tensor(x),
+        op_name="argsort",
+    )
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=int(axis), stable=stable)
+        return jnp.flip(out, axis=int(axis)) if descending else out
+
+    return apply(fn, ensure_tensor(x), op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def fn(v):
+        ax = int(axis) % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, kk)
+        else:
+            vals, idx = jax.lax.top_k(-moved, kk)
+            vals = -vals
+        return (
+            jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(idx.astype(jnp.int32), -1, ax),
+        )
+
+    return apply(fn, x, op_name="topk")
+
+
+def nonzero(x, as_tuple=False):
+    """Eager-only (dynamic output shape), matching reference host-sync."""
+    x = ensure_tensor(x)
+    idx = np.nonzero(np.asarray(jax.device_get(x._value)))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, jnp.int32).reshape(-1, 1)) for i in idx)
+    return Tensor(jnp.stack([jnp.asarray(i, jnp.int32) for i in idx], axis=1) if idx else jnp.zeros((0, x.ndim), jnp.int32))
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+
+    return _is(x, index, axis)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return apply(
+        lambda s, v: jnp.searchsorted(
+            s, v, side="right" if right else "left"
+        ).astype(jnp.int32 if out_int32 else to_jax_dtype("int64")),
+        ensure_tensor(sorted_sequence),
+        ensure_tensor(values),
+        op_name="searchsorted",
+    )
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        ax = int(axis) % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax)
+        vals = jnp.take(sv, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax).astype(jnp.int32)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+
+    return apply(fn, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    xv = np.asarray(jax.device_get(x._value))
+    from scipy import stats  # available transitively; fall back if not
+
+    try:
+        m = stats.mode(xv, axis=axis, keepdims=keepdim)
+        vals, _ = m.mode, m.count
+    except Exception:
+        raise NotImplementedError("mode requires scipy")
+    idxv = np.argmax(
+        np.asarray(xv == np.expand_dims(vals, axis) if not keepdim else xv == vals),
+        axis=axis,
+    )
+    if keepdim:
+        idxv = np.expand_dims(idxv, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxv, jnp.int32))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdim)
+        # 'min' mode: lower of the two middles
+        ax = axis if axis is not None else None
+        if ax is None:
+            flat = jnp.sort(v.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        sv = jnp.sort(v, axis=ax)
+        k = (v.shape[ax] - 1) // 2
+        out = jnp.take(sv, k, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return apply(fn, x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+        ensure_tensor(x),
+        op_name="nanmedian",
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply(
+        lambda v: jnp.quantile(
+            v, jnp.asarray(qv), axis=axis, keepdims=keepdim, method=interpolation
+        ),
+        ensure_tensor(x),
+        op_name="quantile",
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply(
+        lambda v: jnp.nanquantile(
+            v, jnp.asarray(qv), axis=axis, keepdims=keepdim, method=interpolation
+        ),
+        ensure_tensor(x),
+        op_name="nanquantile",
+    )

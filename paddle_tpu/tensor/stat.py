@@ -1,0 +1,46 @@
+"""Statistics ops (reference surface: python/paddle/tensor/stat.py —
+unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, axes_arg
+
+__all__ = ["mean", "std", "var", "numel", "histogramdd"]
+
+from .math import mean  # noqa: F401  (paddle exposes mean in stat too)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.var(
+            v, axis=axes_arg(axis), ddof=1 if unbiased else 0, keepdims=keepdim
+        ),
+        ensure_tensor(x),
+        op_name="var",
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.std(
+            v, axis=axes_arg(axis), ddof=1 if unbiased else 0, keepdims=keepdim
+        ),
+        ensure_tensor(x),
+        op_name="std",
+    )
+
+
+def numel(x, name=None):
+    return ensure_tensor(x).numel()
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        h, edges = jnp.histogramdd(v, bins=bins, range=ranges, density=density)
+        return (h, *edges)
+
+    out = apply(fn, x, op_name="histogramdd")
+    return out[0], list(out[1:])

@@ -1,0 +1,16 @@
+"""Test config: force an 8-device virtual CPU platform.
+
+The reference's distributed CI spawns N processes on one host
+(SURVEY.md §4); the TPU-native analog is cheaper — one process with 8
+virtual CPU devices, so every mesh/sharding test runs anywhere.
+Must run before any jax backend is initialized.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,95 @@
+"""OpTest harness — the numpy-oracle + numeric-gradient test pattern.
+
+Replicates the semantics of the reference's crown-jewel test harness
+(test/legacy_test/op_test.py — unverified path, SURVEY.md §4): each op
+test supplies inputs and a NumPy reference; ``check_output`` compares
+forward results, ``check_grad`` compares analytic gradients against
+central finite differences. A jit cross-check replaces the reference's
+eager-vs-static cross-check.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+
+
+def _to_numpy(out):
+    if isinstance(out, paddle.Tensor):
+        return out.numpy()
+    return np.asarray(out)
+
+
+class OpTest:
+    """Base class; subclasses set ``self.op`` and call the checkers."""
+
+    rtol = 1e-5
+    atol = 1e-6
+    grad_rtol = 1e-2
+    grad_atol = 1e-3
+    fd_eps = 1e-3
+
+    def check_output(self, op, np_ref, inputs, jit_check=True, **kwargs):
+        """op(paddle tensors) vs np_ref(numpy arrays); also under jax.jit."""
+        tensors = [paddle.to_tensor(x) for x in inputs]
+        out = op(*tensors, **kwargs)
+        ref = np_ref(*[np.asarray(x) for x in inputs])
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        refs = ref if isinstance(ref, (tuple, list)) else [ref]
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                _to_numpy(o), r, rtol=self.rtol, atol=self.atol,
+                err_msg=f"forward mismatch for {op}",
+            )
+        if jit_check:
+            jitted = jax.jit(lambda *ts: op(*ts, **kwargs))
+            jout = jitted(*tensors)
+            jouts = jout if isinstance(jout, (tuple, list)) else [jout]
+            for o, r in zip(jouts, refs):
+                np.testing.assert_allclose(
+                    _to_numpy(o), r, rtol=self.rtol, atol=self.atol,
+                    err_msg=f"jit forward mismatch for {op}",
+                )
+        return out
+
+    def check_grad(self, op, inputs, grad_input_idx=None, out_index=None, **kwargs):
+        """Analytic grad (tape backward) vs central finite differences."""
+        inputs = [np.asarray(x, np.float64) for x in inputs]
+        n = len(inputs)
+        grad_input_idx = grad_input_idx if grad_input_idx is not None else range(n)
+
+        def scalar_fn(*arrays):
+            ts = [paddle.to_tensor(a.astype(np.float32)) for a in arrays]
+            out = op(*ts, **kwargs)
+            if isinstance(out, (tuple, list)):
+                out = out[out_index or 0]
+            return float(out.sum().numpy())
+
+        # analytic
+        ts = [
+            paddle.to_tensor(a.astype(np.float32), stop_gradient=False)
+            for a in inputs
+        ]
+        out = op(*ts, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[out_index or 0]
+        out.sum().backward()
+
+        for i in grad_input_idx:
+            analytic = ts[i].grad.numpy().astype(np.float64)
+            numeric = np.zeros_like(inputs[i])
+            flat = inputs[i].reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for j in range(flat.size):
+                orig = flat[j]
+                flat[j] = orig + self.fd_eps
+                f_plus = scalar_fn(*inputs)
+                flat[j] = orig - self.fd_eps
+                f_minus = scalar_fn(*inputs)
+                flat[j] = orig
+                num_flat[j] = (f_plus - f_minus) / (2 * self.fd_eps)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=self.grad_rtol, atol=self.grad_atol,
+                err_msg=f"gradient mismatch for {op} input {i}",
+            )

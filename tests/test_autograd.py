@@ -1,0 +1,157 @@
+"""Autograd engine semantics: tape, hooks, in-place versioning, no_grad."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_basic_backward():
+    a = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    b = paddle.to_tensor([4.0, 5.0], stop_gradient=False)
+    ((a * b).sum()).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4, 5])
+    np.testing.assert_allclose(b.grad.numpy(), [2, 3])
+
+
+def test_grad_accumulation():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    (a * 2).backward()
+    (a * 3).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [5.0])
+
+
+def test_stop_gradient_blocks():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = paddle.to_tensor([2.0])  # stop_gradient=True default
+    out = (a * b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0])
+    assert b.grad is None
+
+
+def test_detach_breaks_graph():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    c = (a * 2).detach()
+    assert c.stop_gradient
+    d = paddle.to_tensor([1.0], stop_gradient=False)
+    (c * d).backward()
+    assert a.grad is None
+
+
+def test_no_grad_context():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        out = a * 2
+    assert out.stop_gradient
+
+
+def test_no_grad_decorator():
+    @paddle.no_grad()
+    def fn(x):
+        return x * 2
+
+    out = fn(paddle.to_tensor([1.0], stop_gradient=False))
+    assert out.stop_gradient
+
+
+def test_backward_nonscalar_needs_grad():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        (a * 2).backward()
+    (a * 2).backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(a.grad.numpy(), [2, 2])
+
+
+def test_grad_api():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 6.0)
+    # paddle.grad must not pollute .grad
+    assert x.grad is None
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    z = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x * x
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, z])
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    assert gz is None
+
+
+def test_register_hook():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    a.register_hook(lambda g: g * 10)
+    (a * 2).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [20.0])
+
+
+def test_retain_grads_intermediate():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = a * 2
+    b.retain_grads()
+    (b * 3).backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3.0])
+
+
+def test_inplace_versioning():
+    w = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    v = w * 2
+    v.scale_(3.0)
+    v.sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [6.0, 6.0])
+
+
+def test_leaf_inplace_then_new_graph():
+    p = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    (p * p).sum().backward()
+    np.testing.assert_allclose(p.grad.numpy(), [2, 2])
+    with paddle.no_grad():
+        p.scale_(0.5)
+    p.clear_grad()
+    (p * p).sum().backward()
+    np.testing.assert_allclose(p.grad.numpy(), [1, 1])
+
+
+def test_setitem_grad():
+    x = paddle.zeros([3], dtype="float32")
+    x.stop_gradient = False
+    y = paddle.to_tensor([5.0], stop_gradient=False)
+    z = x * 2
+    z[1] = y[0] * 3
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_multi_output_partial_grad():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    out = Double.apply(x)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_double_backward_raises():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x * x
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y, x, create_graph=True)

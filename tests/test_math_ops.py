@@ -1,0 +1,174 @@
+"""OpTest-style coverage for the math op corpus."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+rng = np.random.default_rng(0)
+
+
+def data(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def pos(*shape):
+    return (np.abs(data(*shape)) + 0.5).astype(np.float32)
+
+
+class TestUnary(OpTest):
+    @pytest.mark.parametrize(
+        "op,ref,positive",
+        [
+            (paddle.exp, np.exp, False),
+            (paddle.log, np.log, True),
+            (paddle.sqrt, np.sqrt, True),
+            (paddle.tanh, np.tanh, False),
+            (paddle.sin, np.sin, False),
+            (paddle.cos, np.cos, False),
+            (paddle.abs, np.abs, False),
+            (paddle.square, np.square, False),
+            (paddle.floor, np.floor, False),
+            (paddle.ceil, np.ceil, False),
+            (paddle.log1p, np.log1p, True),
+            (paddle.expm1, np.expm1, False),
+            (paddle.rsqrt, lambda x: 1 / np.sqrt(x), True),
+            (paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x)), False),
+            (paddle.reciprocal, lambda x: 1 / x, True),
+        ],
+    )
+    def test_forward(self, op, ref, positive):
+        x = pos(3, 4) if positive else data(3, 4)
+        self.check_output(op, ref, [x])
+
+    @pytest.mark.parametrize(
+        "op,positive",
+        [
+            (paddle.exp, False),
+            (paddle.log, True),
+            (paddle.sqrt, True),
+            (paddle.tanh, False),
+            (paddle.sigmoid, False),
+        ],
+    )
+    def test_grad(self, op, positive):
+        x = pos(2, 3) if positive else data(2, 3)
+        self.check_grad(op, [x])
+
+
+class TestBinary(OpTest):
+    @pytest.mark.parametrize(
+        "op,ref",
+        [
+            (paddle.add, np.add),
+            (paddle.subtract, np.subtract),
+            (paddle.multiply, np.multiply),
+            (paddle.divide, np.divide),
+            (paddle.maximum, np.maximum),
+            (paddle.minimum, np.minimum),
+            (paddle.atan2, np.arctan2),
+        ],
+    )
+    def test_forward(self, op, ref):
+        x, y = data(3, 4), pos(3, 4)
+        self.check_output(op, ref, [x, y])
+
+    def test_broadcast(self):
+        self.check_output(paddle.add, np.add, [data(3, 1, 4), data(2, 1)])
+
+    def test_grad_mul(self):
+        self.check_grad(paddle.multiply, [data(2, 3), data(2, 3)])
+
+    def test_grad_div_broadcast(self):
+        self.check_grad(paddle.divide, [data(2, 3), pos(3)])
+
+    def test_pow_scalar(self):
+        x = pos(3, 4)
+        out = paddle.pow(paddle.to_tensor(x), 2.0)
+        np.testing.assert_allclose(out.numpy(), x**2, rtol=1e-5)
+
+
+class TestReduce(OpTest):
+    @pytest.mark.parametrize(
+        "op,ref",
+        [
+            (paddle.sum, np.sum),
+            (paddle.mean, np.mean),
+            (paddle.max, np.max),
+            (paddle.min, np.min),
+            (paddle.prod, np.prod),
+        ],
+    )
+    @pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ((0, 2), False)])
+    def test_forward(self, op, ref, axis, keepdim):
+        if op in (paddle.max, paddle.min) and isinstance(axis, tuple):
+            pytest.skip("paddle max/min take int axis")
+        x = data(2, 3, 4)
+        self.check_output(
+            lambda t: op(t, axis=axis, keepdim=keepdim),
+            lambda a: ref(a, axis=axis, keepdims=keepdim),
+            [x],
+        )
+
+    def test_grad_sum(self):
+        self.check_grad(lambda t: paddle.sum(t, axis=1), [data(2, 3)])
+
+    def test_grad_mean(self):
+        self.check_grad(paddle.mean, [data(2, 3)])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp
+
+        x = data(3, 4)
+        self.check_output(
+            lambda t: paddle.logsumexp(t, axis=1),
+            lambda a: logsumexp(a, axis=1),
+            [x],
+        )
+
+    def test_cumsum(self):
+        x = data(3, 4)
+        self.check_output(
+            lambda t: paddle.cumsum(t, axis=1),
+            lambda a: np.cumsum(a, axis=1),
+            [x],
+        )
+        self.check_output(
+            paddle.cumsum, lambda a: np.cumsum(a.reshape(-1)), [x]
+        )
+
+
+class TestClipScale(OpTest):
+    def test_clip(self):
+        x = data(3, 4)
+        self.check_output(
+            lambda t: paddle.clip(t, -0.5, 0.5),
+            lambda a: np.clip(a, -0.5, 0.5),
+            [x],
+        )
+
+    def test_scale(self):
+        x = data(3, 4)
+        self.check_output(
+            lambda t: paddle.scale(t, scale=2.0, bias=1.0),
+            lambda a: a * 2 + 1,
+            [x],
+        )
+        self.check_output(
+            lambda t: paddle.scale(t, scale=2.0, bias=1.0, bias_after_scale=False),
+            lambda a: (a + 1) * 2,
+            [x],
+        )
+
+
+class TestDtypes(OpTest):
+    def test_int_sum_promotes(self):
+        x = np.arange(6, dtype=np.int32).reshape(2, 3)
+        out = paddle.sum(paddle.to_tensor(x))
+        assert out.numpy() == 15
+
+    def test_bf16_matmul(self):
+        x = paddle.ones([4, 4], dtype="bfloat16")
+        out = paddle.matmul(x, x)
+        assert out.dtype.name == "bfloat16"
+        np.testing.assert_allclose(out.astype("float32").numpy(), 4 * np.ones((4, 4)))
