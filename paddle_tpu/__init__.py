@@ -83,3 +83,20 @@ class batch:
 
 # Subsystem namespaces (populated progressively; each mirrors paddle.<ns>).
 from . import autograd  # noqa: E402
+from . import nn  # noqa: E402
+from .nn.layer.layers import ParamAttr  # noqa: E402
+from . import optimizer  # noqa: E402
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import amp  # noqa: E402
+from . import io  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from .framework.io import save, load  # noqa: E402
+from . import framework  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from .hapi.model import Model  # noqa: E402
+from . import hapi  # noqa: E402
+from . import callbacks  # noqa: E402
+from .hapi.summary import summary, flops  # noqa: E402

@@ -1,0 +1,5 @@
+"""paddle.callbacks namespace (alias of hapi callbacks, as in reference)."""
+from ..hapi.callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+    Terminate,
+)

@@ -22,6 +22,21 @@ __all__ = ["apply", "unwrap", "wrap_single", "OP_REGISTRY", "register_op"]
 # op name → python callable (introspection / paddle "kernel registry" analog)
 OP_REGISTRY: dict[str, object] = {}
 
+_amp_cache = None
+
+
+def _amp():
+    """Lazily bind the amp module once (avoids an import cycle at boot)."""
+    global _amp_cache
+    if _amp_cache is None:
+        try:
+            from ..amp import amp_state, cast_inputs_for_op
+
+            _amp_cache = (amp_state, cast_inputs_for_op)
+        except ImportError:
+            _amp_cache = False
+    return _amp_cache or None
+
 
 def register_op(name: str, fn):
     OP_REGISTRY[name] = fn
@@ -66,6 +81,10 @@ def apply(fn, *args, op_name: str = "", **kwargs):
     from .tensor import Tensor
 
     vals = [unwrap(a) for a in args]
+    # AMP: cast inputs per white/black list before tracing the op.
+    amp = _amp()
+    if amp is not None and amp[0].enabled:
+        vals = amp[1](op_name, vals)
     diff_idx = (
         [
             i

@@ -59,6 +59,24 @@ class Generator:
 
 default_generator = Generator(0)
 
+# While tracing (to_static / jitted train steps), random ops must draw from
+# a TRACED key that enters the compiled program as an input — otherwise the
+# mask freezes at trace time. ``traced_key_scope`` pushes such a key.
+_traced_key_stack: list = []
+
+
+class traced_key_scope:
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _traced_key_stack.append([self._key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _traced_key_stack.pop()
+        return False
+
 
 def seed(value: int) -> Generator:
     """paddle.seed(v): reseed the global generator (and return it)."""
@@ -66,6 +84,11 @@ def seed(value: int) -> Generator:
 
 
 def next_key():
+    if _traced_key_stack:
+        entry = _traced_key_stack[-1]
+        k = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return k
     return default_generator.next_key()
 
 

@@ -1,0 +1,80 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py —
+unverified, SURVEY.md §0): pickle protocol with per-tensor raw numpy
+buffers, so checkpoints round-trip state_dicts of Layers and optimizers.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Pickle stand-in for a Tensor: raw ndarray + meta."""
+
+    def __init__(self, array, stop_gradient=True, name=None, is_param=False):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.is_param = is_param
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient, obj._name, True)
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient, obj._name, False)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            p = Parameter(obj.array, trainable=not obj.stop_gradient)
+            p._name = obj.name
+            return p
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient)
+        t._name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    if isinstance(path, str):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+    else:  # file-like
+        pickle.dump(_pack(obj), path, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    if isinstance(path, str):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    else:
+        obj = pickle.load(path)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,263 @@
+"""paddle.Model — the hapi train loop (reference:
+python/paddle/hapi/model.py — unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._scaler = None
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._amp_level = None
+        self._amp_dtype = "float16"
+        if amp_configs is not None:
+            from ..amp import GradScaler
+
+            if isinstance(amp_configs, dict):
+                self._amp_level = amp_configs.get("level", "O1")
+                self._amp_dtype = amp_configs.get("dtype", "float16")
+                scaler_kwargs = {
+                    k: v
+                    for k, v in amp_configs.items()
+                    if k in ("init_loss_scaling", "incr_ratio", "decr_ratio",
+                             "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                             "use_dynamic_loss_scaling")
+                }
+            else:
+                self._amp_level = amp_configs
+                scaler_kwargs = {}
+            # bf16 needs no loss scaling
+            self._scaler = GradScaler(
+                enable=self._amp_dtype == "float16", **scaler_kwargs
+            )
+        return self
+
+    # -- single batch --------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = [
+            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+            for x in _to_list(inputs)
+        ]
+        labels = [
+            y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+            for y in _to_list(labels)
+        ]
+        if self._amp_level:
+            from ..amp import auto_cast
+
+            with auto_cast(level=self._amp_level, dtype=self._amp_dtype):
+                outputs = self.network(*inputs)
+                outputs_l = _to_list(outputs)
+                losses = self._loss(*(outputs_l + labels))
+        else:
+            outputs = self.network(*inputs)
+            outputs_l = _to_list(outputs)
+            losses = self._loss(*(outputs_l + labels))
+        losses_l = _to_list(losses)
+        total = losses_l[0]
+        for extra in losses_l[1:]:
+            total = total + extra
+        if self._scaler is not None and self._scaler.is_enable():
+            self._scaler.scale(total).backward()
+            if update and self._optimizer is not None:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            total.backward()
+            if update and self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = []
+        for metric in self._metrics:
+            res = metric.compute(*(outputs_l + labels))
+            metrics.append(metric.update(*_to_list(res)))
+        loss_vals = [float(v.numpy()) for v in losses_l]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    @autograd.no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [
+            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+            for x in _to_list(inputs)
+        ]
+        labels = [
+            y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+            for y in _to_list(labels)
+        ]
+        outputs = _to_list(self.network(*inputs))
+        loss_vals = []
+        if self._loss is not None and labels:
+            losses = _to_list(self._loss(*(outputs + labels)))
+            loss_vals = [float(v.numpy()) for v in losses]
+        metrics = []
+        for metric in self._metrics:
+            res = metric.compute(*(outputs + labels))
+            metrics.append(metric.update(*_to_list(res)))
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    @autograd.no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [
+            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+            for x in _to_list(inputs)
+        ]
+        outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    # -- loops ---------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._make_loader(train_data, batch_size, shuffle)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=[m.name() for m in self._metrics],
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                batch = _to_list(batch)
+                n_in = len(self._inputs) if self._inputs else len(batch) - 1
+                ins, labs = batch[:n_in], batch[n_in:]
+                result = self.train_batch(ins, labs)
+                if isinstance(result, tuple):
+                    loss_vals, _ = result
+                else:
+                    loss_vals = result
+                logs = {"loss": loss_vals[0]}
+                for m in self._metrics:
+                    logs[str(m.name())] = m.accumulate()
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if self.stop_training or (num_iters and it_count >= num_iters):
+                    if num_iters and it_count >= num_iters:
+                        self.stop_training = True  # ends the epoch loop too
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        from .callbacks import CallbackList
+
+        cbks = callbacks if isinstance(callbacks, CallbackList) else config_callbacks(
+            callbacks, model=self, verbose=verbose
+        )
+        cbks.on_eval_begin()
+        total_loss, n = 0.0, 0
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            n_in = len(self._inputs) if self._inputs else len(batch) - 1
+            ins, labs = batch[:n_in], batch[n_in:]
+            result = self.eval_batch(ins, labs)
+            loss_vals = result[0] if isinstance(result, tuple) else result
+            if loss_vals:
+                total_loss += loss_vals[0]
+                n += 1
+        logs = {"loss": total_loss / max(n, 1)}
+        for m in self._metrics:
+            logs[str(m.name())] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            n_in = len(self._inputs) if self._inputs else len(batch)
+            outs = self.predict_batch(batch[:n_in])
+            outputs.append(outs)
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [
+                np.concatenate([o[i] for o in outputs]) for i in range(n_out)
+            ]
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        import os
+
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(
+            path + ".pdopt"
+        ):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtype)

@@ -1,0 +1,340 @@
+"""paddle.jit — to_static on jax.jit (reference: python/paddle/jit/ —
+unverified, SURVEY.md §0).
+
+The reference lowers Python to ProgramDesc via AST transforms/SOT bytecode
+tracing; here XLA is the static runtime, so ``to_static`` wraps the
+function in ONE dispatch-op whose kernel is a ``jax.jit``-compiled
+functional version of the forward: layer params/buffers are swapped to
+traced values inside (functional_call), gradients flow through the outer
+``jax.vjp`` exactly like any other op, and buffer mutations (BN running
+stats) are returned as auxiliary outputs and written back. Guard-based
+retrace = jax.jit's shape/dtype cache plus a static-kwargs key.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dispatch import apply
+from ..core import autograd
+
+__all__ = [
+    "to_static", "not_to_static", "ignore_module", "save", "load",
+    "functional_call", "TranslatedLayer", "enable_to_static",
+]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag=True):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def functional_call(layer, fn, args, kwargs, param_values, buffer_values):
+    """Run ``fn`` with layer params/buffers temporarily rebound to the given
+    (possibly traced) values; returns (output, new_buffer_values)."""
+    params = [p for _, p in layer.named_parameters()]
+    buffers = [b for _, b in layer.named_buffers()]
+    saved_p = [p._value for p in params]
+    saved_b = [b._value for b in buffers]
+    try:
+        for p, v in zip(params, param_values):
+            p._value = v
+        for b, v in zip(buffers, buffer_values):
+            b._value = v
+        out = fn(*args, **kwargs)
+        new_buf = [b._value for b in buffers]
+        return out, new_buf
+    finally:
+        for p, v in zip(params, saved_p):
+            p._value = v
+        for b, v in zip(buffers, saved_b):
+            b._value = v
+
+
+class StaticFunction:
+    """The object returned by @to_static on a function/Layer.forward."""
+
+    def __init__(self, function, layer=None, input_spec=None,
+                 build_strategy=None, full_graph=True):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jit_cache: dict = {}
+        self.__name__ = getattr(function, "__name__", "forward")
+
+    def __get__(self, instance, owner):
+        # class-level @to_static decoration: bind like a method
+        if instance is None:
+            return self
+        import types
+
+        return types.MethodType(self, instance)
+
+    def _get_layer(self, args):
+        from ..nn.layer.layers import Layer
+
+        if self._layer is not None:
+            return self._layer, self._function, args
+        fn = self._function
+        if hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+            return fn.__self__, fn, args
+        if args and isinstance(args[0], Layer):
+            return args[0], fn.__get__(args[0]), args[1:]
+        return None, fn, args
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            layer, fn, call_args = self._get_layer(args)
+            return fn(*call_args, **kwargs)
+        layer, fn, call_args = self._get_layer(args)
+
+        tensor_args = []
+        arg_spec = []
+        for a in call_args:
+            if isinstance(a, np.ndarray):
+                a = Tensor(a)  # arrays are data, not static config
+            if isinstance(a, Tensor):
+                arg_spec.append(("t", len(tensor_args)))
+                tensor_args.append(a)
+            else:
+                arg_spec.append(("s", a))
+
+        params = [p for _, p in layer.named_parameters()] if layer else []
+        buffers = [b for _, b in layer.named_buffers()] if layer else []
+        n_args = len(tensor_args)
+        n_params = len(params)
+        training = layer.training if layer is not None else False
+        static_key = (
+            tuple(
+                (kind, repr(v)) if kind == "s" else (kind, v)
+                for kind, v in arg_spec
+            ),
+            tuple(sorted((k, repr(v)) for k, v in kwargs.items())),
+            training,
+            n_params,
+            len(buffers),
+        )
+
+        if static_key not in self._jit_cache:
+            layer_ref = layer
+            fn_ref = fn
+            spec = list(arg_spec)
+            kw = dict(kwargs)
+            meta = {}  # treedef captured at first trace (static metadata)
+
+            def jittable(args_vals, param_vals, buffer_vals, rng_key):
+                from ..core.random import traced_key_scope
+
+                rebuilt = [
+                    Tensor(args_vals[v], stop_gradient=True) if kind == "t" else v
+                    for kind, v in spec
+                ]
+                with autograd.no_grad(), traced_key_scope(rng_key):
+                    if layer_ref is not None:
+                        out, new_buf = functional_call(
+                            layer_ref, fn_ref, rebuilt, kw, param_vals,
+                            buffer_vals,
+                        )
+                    else:
+                        out = fn_ref(*rebuilt, **kw)
+                        new_buf = []
+                flat, treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor)
+                )
+                meta["treedef"] = treedef
+                flat_vals = [
+                    t._value if isinstance(t, Tensor) else t for t in flat
+                ]
+                return flat_vals, new_buf
+
+            self._jit_cache[static_key] = (jax.jit(jittable), meta)
+
+        jitted, meta = self._jit_cache[static_key]
+
+        from ..core.random import next_key
+
+        rng_key = next_key()
+        buffer_vals = [b._value for b in buffers]
+
+        def op_fn(*all_vals):
+            a_vals = list(all_vals[:n_args])
+            p_vals = list(all_vals[n_args : n_args + n_params])
+            b_vals = list(all_vals[n_args + n_params :])
+            flat_vals, new_buf = jitted(a_vals, p_vals, b_vals, rng_key)
+            return tuple(flat_vals) + tuple(new_buf)
+
+        results = apply(
+            op_fn, *tensor_args, *params,
+            *[Tensor(v) for v in buffer_vals],
+            op_name="to_static",
+        )
+        results = results if isinstance(results, tuple) else (results,)
+        n_buf = len(buffers)
+        out_flat = list(results[: len(results) - n_buf])
+        new_buf = results[len(results) - n_buf :]
+        for b, nb in zip(buffers, new_buf):
+            b._value = nb._value
+        out = jax.tree_util.tree_unflatten(meta["treedef"], out_flat)
+        return out
+
+    # -- introspection (CINN-story surface: lowered StableHLO) --------------
+    def concrete_program(self, *args):
+        return None
+
+    def get_stablehlo(self, *args, **kwargs):
+        """Lower the traced function to StableHLO text (the reference's
+        CINN fused-subgraph analog — SURVEY.md §2.2 TPU mapping note)."""
+        layer, _, call_args = self._get_layer(args)
+        tensor_args = [a for a in call_args if isinstance(a, Tensor)]
+        params = [p for _, p in layer.named_parameters()] if layer else []
+        buffers = [b for _, b in layer.named_buffers()] if layer else []
+        if not self._jit_cache:
+            self(*args, **kwargs)
+        jitted, _ = next(iter(self._jit_cache.values()))
+        lowered = jitted.lower(
+            [t._value for t in tensor_args],
+            [p._value for p in params],
+            [b._value for b in buffers],
+            jax.random.PRNGKey(0),
+        )
+        return str(lowered.compiler_ir(dialect="stablehlo"))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper: paddle.jit.to_static."""
+    from ..nn.layer.layers import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            static_fn = StaticFunction(
+                obj.forward, layer=obj, input_spec=input_spec,
+                build_strategy=build_strategy,
+            )
+            obj.forward = static_fn
+            return obj
+        return StaticFunction(obj, input_spec=input_spec,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TranslatedLayer:
+    """Inference layer reconstructed from an exported program (jit.load)."""
+
+    def __init__(self, exported, params):
+        self._exported = exported
+        self._params = params
+
+    def __call__(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else np.asarray(a) for a in args]
+        out = self._exported.call(*vals, *self._params)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+    def forward(self, *args):
+        return self(*args)
+
+    def eval(self):
+        return self
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save: StableHLO-exported program + params.
+
+    Writes ``path.pdmodel`` (serialized jax.export artifact; the
+    reference's ProgramDesc analog) and ``path.pdiparams`` (params npz).
+    """
+    from ..nn.layer.layers import Layer
+    from ..static import InputSpec
+
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer")
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on this backend")
+
+    example_args = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if (s is None or s < 0) else s for s in spec.shape]
+            from ..core.dtype import to_jax_dtype
+            import jax.numpy as jnp
+
+            example_args.append(jnp.zeros(shape, to_jax_dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            example_args.append(spec._value)
+        else:
+            example_args.append(np.asarray(spec))
+
+    params = [p for _, p in layer.named_parameters()]
+    buffers = [b for _, b in layer.named_buffers()]
+    layer.eval()
+
+    fwd = layer.forward
+    if isinstance(fwd, StaticFunction):
+        fwd = fwd._function
+
+    def infer_fn(*arg_vals):
+        n = len(example_args)
+        a_vals = arg_vals[:n]
+        p_vals = arg_vals[n:]
+        args_t = [Tensor(v) for v in a_vals]
+        with autograd.no_grad():
+            out, _ = functional_call(
+                layer, fwd, args_t, {},
+                list(p_vals[: len(params)]),
+                list(p_vals[len(params) :]),
+            )
+        flat, _ = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        return tuple(t._value if isinstance(t, Tensor) else t for t in flat)
+
+    import jax.export as jexport
+
+    jitted = jax.jit(infer_fn)
+    exported = jexport.export(jitted)(
+        *example_args,
+        *[p._value for p in params],
+        *[b._value for b in buffers],
+    )
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    np.savez(
+        path + ".pdiparams",
+        **{
+            f"p{i}": np.asarray(jax.device_get(p._value))
+            for i, p in enumerate(params + buffers)
+        },
+    )
+
+
+def load(path, **configs):
+    """paddle.jit.load → TranslatedLayer."""
+    import jax.export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    data = np.load(path + ".pdiparams.npz")
+    params = [data[f"p{i}"] for i in range(len(data.files))]
+    return TranslatedLayer(exported, params)

@@ -1,0 +1,191 @@
+"""paddle.metric (reference: python/paddle/metric/metrics.py — unverified,
+SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy."""
+    from ..tensor.search import topk as _topk
+    import jax.numpy as jnp
+    from ..core.dispatch import apply
+
+    input = input if isinstance(input, Tensor) else Tensor(input)
+    label = label if isinstance(label, Tensor) else Tensor(label)
+
+    def fn(logits, lab):
+        _, pred = __import__("jax").lax.top_k(logits, k)
+        if lab.ndim == logits.ndim:
+            lab_ = lab
+        else:
+            lab_ = lab.reshape(lab.shape + (1,))
+        hit = jnp.any(pred == lab_, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply(fn, input, label, op_name="accuracy")
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = pred if isinstance(pred, Tensor) else Tensor(pred)
+        label = label if isinstance(label, Tensor) else Tensor(label)
+        import jax
+        import jax.numpy as jnp
+        from ..core.dispatch import apply
+
+        maxk = self.maxk
+
+        def fn(logits, lab):
+            _, top = jax.lax.top_k(logits, maxk)
+            if lab.ndim == 1:
+                lab_ = lab[:, None]
+            else:
+                lab_ = lab
+            return (top == lab_).astype(jnp.float32)
+
+        return apply(fn, pred, label, op_name="acc_compute")
+
+    def update(self, correct, *args):
+        arr = correct.numpy() if isinstance(correct, Tensor) else np.asarray(correct)
+        num_samples = arr.shape[0]
+        accs = []
+        for k in self.topk:
+            num_corrects = arr[:, :k].sum()
+            self.total[self.topk.index(k)] += num_corrects
+            self.count[self.topk.index(k)] += num_samples
+            accs.append(float(num_corrects) / num_samples)
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [
+            t / c if c > 0 else 0.0 for t, c in zip(self.total, self.count)
+        ]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        labels = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_pos = (preds.reshape(-1) > 0.5).astype(np.int32)
+        lab = labels.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_pos == 1) & (lab == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (lab == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        labels = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_pos = (preds.reshape(-1) > 0.5).astype(np.int32)
+        lab = labels.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_pos == 1) & (lab == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (lab == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        labels = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        lab = labels.reshape(-1)
+        bins = np.clip(
+            (pos_prob * self.num_thresholds).astype(np.int64), 0,
+            self.num_thresholds,
+        )
+        for b, l in zip(bins, lab):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return auc / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name

@@ -1,0 +1,14 @@
+"""paddle.nn namespace (reference: python/paddle/nn/ — unverified,
+SURVEY.md §0)."""
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from . import functional  # noqa: F401
+from . import functional as F  # noqa: F401
+
+# grad-clip classes live under paddle.nn in the reference
+from ..optimizer.clip import (  # noqa: F401
+    ClipGradByValue,
+    ClipGradByNorm,
+    ClipGradByGlobalNorm,
+)

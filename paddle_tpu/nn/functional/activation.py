@@ -1,0 +1,230 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py
+— unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+
+
+def _act(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, ensure_tensor(x), op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _act(jax.nn.relu, "relu")
+relu6 = _act(jax.nn.relu6, "relu6")
+sigmoid = _act(jax.nn.sigmoid, "sigmoid")
+tanh = _act(jnp.tanh, "tanh")
+silu = _act(jax.nn.silu, "silu")
+softsign = _act(jax.nn.soft_sign, "softsign")
+tanhshrink = _act(lambda x: x - jnp.tanh(x), "tanhshrink")
+mish = _act(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+log_sigmoid = _act(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def relu_(x):
+    return x._rebind(relu(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(
+        lambda v: jax.nn.gelu(v, approximate=approximate),
+        ensure_tensor(x),
+        op_name="gelu",
+    )
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if jdt is not None:
+            v = v.astype(jdt)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply(fn, ensure_tensor(x), op_name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_jax_dtype
+
+    jdt = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if jdt is not None:
+            v = v.astype(jdt)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply(fn, ensure_tensor(x), op_name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(
+        lambda v: jax.nn.leaky_relu(v, negative_slope),
+        ensure_tensor(x),
+        op_name="leaky_relu",
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), ensure_tensor(x), op_name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply(
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+        ensure_tensor(x),
+        op_name="selu",
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(
+        lambda v: jax.nn.celu(v, alpha), ensure_tensor(x), op_name="celu"
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+        ensure_tensor(x),
+        op_name="hardshrink",
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda v: jnp.where(
+            v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)
+        ),
+        ensure_tensor(x),
+        op_name="softshrink",
+    )
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(
+        lambda v: jnp.clip(slope * v + offset, 0.0, 1.0),
+        ensure_tensor(x),
+        op_name="hardsigmoid",
+    )
+
+
+def hardswish(x, name=None):
+    return apply(
+        lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0,
+        ensure_tensor(x),
+        op_name="hardswish",
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(
+        lambda v: jnp.clip(v, min, max), ensure_tensor(x), op_name="hardtanh"
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda v: jnp.where(
+            beta * v > threshold, v, (1.0 / beta) * jnp.log1p(jnp.exp(beta * v))
+        ),
+        ensure_tensor(x),
+        op_name="softplus",
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(
+        lambda v: jnp.where(v > threshold, v, value),
+        ensure_tensor(x),
+        op_name="thresholded_relu",
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def fn(v, w):
+        if w.size > 1:
+            ch_axis = 1 if data_format == "NCHW" and v.ndim > 1 else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v >= 0, v, w * v)
+
+    return apply(fn, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    x = ensure_tensor(x)
+    if training:
+        from ...core.random import next_key
+
+        key = next_key()
+        return apply(
+            lambda v: jnp.where(
+                v >= 0,
+                v,
+                v * jax.random.uniform(key, v.shape, v.dtype, lower, upper),
+            ),
+            x,
+            op_name="rrelu",
+        )
+    mid = (lower + upper) / 2.0
+    return apply(lambda v: jnp.where(v >= 0, v, mid * v), x, op_name="rrelu")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), ensure_tensor(x), op_name="glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply(fn, x, op_name="maxout")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...tensor.random import gumbel_softmax as _gs
+
+    return _gs(x, temperature, hard, axis)
+
+
+__all__ = [
+    n
+    for n, v in list(globals().items())
+    if not n.startswith("_")
+    and callable(v)
+    and getattr(v, "__module__", None) == __name__
+]

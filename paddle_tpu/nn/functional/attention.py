@@ -1,0 +1,133 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` mirrors paddle's API (reference:
+python/paddle/nn/functional/flash_attention.py — unverified, SURVEY.md §0)
+and routes to the Pallas flash-attention kernel on TPU (the analog of the
+reference's vendored flash-attn CUDA kernel), falling back to a fused XLA
+softmax-attention elsewhere.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+from ...core.flags import get_flags
+
+
+def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
+                   key=None):
+    """Reference attention in pure XLA ops; layout (B, S, H, D)."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    # (B, H, Sq, Sk)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Layout (batch, seq, num_heads, head_dim) — paddle's flash-attn layout."""
+    query, key_, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    use_pallas = (
+        get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"]
+        and attn_mask is None
+        and (dropout_p == 0.0 or not training)
+        and jax.default_backend() == "tpu"
+        and query._value.shape[-1] >= 64
+    )
+    if use_pallas:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention
+
+            return apply(
+                lambda q, k, v: flash_attention(q, k, v, causal=is_causal),
+                query, key_, value, op_name="flash_attention",
+            )
+        except Exception:
+            pass
+
+    rng_key = None
+    if dropout_p > 0.0 and training:
+        from ...core.random import next_key
+
+        rng_key = next_key()
+
+    def fn(q, k, v, *maybe_mask):
+        m = maybe_mask[0] if maybe_mask else None
+        return _xla_attention(
+            q, k, v, mask=m, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, key=rng_key,
+        )
+
+    args = [query, key_, value]
+    if attn_mask is not None:
+        args.append(ensure_tensor(attn_mask))
+    return apply(fn, *args, op_name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention: (total_tokens, H, D) + cumulative seqlens.
+
+    Implemented as segment-masked attention — segments are derived from
+    cu_seqlens, the Pallas kernel consumes segment ids natively.
+    """
+    query, key_, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    cu_q = ensure_tensor(cu_seqlens_q)
+
+    def fn(q, k, v, cq):
+        # build segment ids from cumulative lens: token i in segment s
+        total = q.shape[0]
+        pos = jnp.arange(total)
+        seg = jnp.searchsorted(cq[1:], pos, side="right")
+        sc = scale
+        logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sc
+        seg_mask = seg[:, None] == seg[None, :]
+        if causal:
+            seg_mask = seg_mask & (pos[:, None] >= pos[None, :])
+        logits = jnp.where(seg_mask[None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    out = apply(fn, query, key_, value, cu_q, op_name="flash_attn_unpadded")
+    return out, None
+
+
+__all__ = [
+    "scaled_dot_product_attention", "flash_attention", "flash_attn_unpadded",
+]

@@ -1,0 +1,183 @@
+"""Convolution functionals via lax.conv_general_dilated — the direct MXU
+path on TPU (reference surface: python/paddle/nn/functional/conv.py —
+unverified, SURVEY.md §0). Weight layout matches paddle: OIHW (out_ch,
+in_ch/groups, *spatial).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    return v if len(v) == n else tuple(v[i % len(v)] for i in range(n))
+
+
+def _padding_arg(padding, n, strides=None):
+    """paddle padding: int | list | 'SAME' | 'VALID' → lax padding config."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # full-rank [[0,0],[0,0],[top,bottom],[left,right]]
+        spatial = [tuple(p) for p in padding[-n:]]
+        return spatial
+    raise ValueError(f"unsupported padding spec {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    strides = _tuplize(stride, n)
+    dilations = _tuplize(dilation, n)
+    pad = _padding_arg(padding, n, strides)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - n :]
+        spatial = "DHW"[3 - n :]
+        dn = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
+    else:
+        spatial = "DHW"[3 - n :]
+        dn = (f"N{spatial}C", f"OI{spatial}", f"N{spatial}C")
+
+    def fn(v, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            v,
+            w,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=(
+                jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else None
+            ),
+        )
+        out = out.astype(v.dtype)
+        if maybe_b:
+            b = maybe_b[0]
+            if data_format.startswith("NC"):
+                b = b.reshape((1, -1) + (1,) * n)
+            else:
+                b = b.reshape((1,) + (1,) * n + (-1,))
+            out = out + b.astype(out.dtype)
+        return out
+
+    args = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply(fn, *args, op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, n, output_size=None):
+    strides = _tuplize(stride, n)
+    dilations = _tuplize(dilation, n)
+    pad = _padding_arg(padding, n, strides)
+    opad = _tuplize(output_padding, n)
+    spatial = "DHW"[3 - n :]
+    if data_format.startswith("NC"):
+        dn = (f"NC{spatial}", f"IO{spatial}", f"NC{spatial}")
+    else:
+        dn = (f"N{spatial}C", f"IO{spatial}", f"N{spatial}C")
+
+    def fn(v, w, *maybe_b):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # conv_transpose padding: lax.conv_transpose handles the
+            # transpose-of-padding arithmetic when given explicit config
+            k = [
+                (w.shape[2 + i] - 1) * dilations[i] for i in range(n)
+            ]
+            padding_cfg = [
+                (k[i] - pad[i][0], k[i] - pad[i][1] + opad[i]) for i in range(n)
+            ]
+        if groups > 1:
+            # grouped transpose conv: split along channel groups
+            vs = jnp.split(v, groups, axis=1 if data_format.startswith("NC") else -1)
+            ws = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_general_dilated(
+                    vg, wg,
+                    window_strides=(1,) * n,
+                    padding=padding_cfg,
+                    lhs_dilation=strides,
+                    rhs_dilation=dilations,
+                    dimension_numbers=dn,
+                )
+                for vg, wg in zip(vs, ws)
+            ]
+            out = jnp.concatenate(outs, axis=1 if data_format.startswith("NC") else -1)
+        else:
+            out = jax.lax.conv_general_dilated(
+                v, w,
+                window_strides=(1,) * n,
+                padding=padding_cfg,
+                lhs_dilation=strides,
+                rhs_dilation=dilations,
+                dimension_numbers=dn,
+            )
+        if maybe_b:
+            b = maybe_b[0]
+            if data_format.startswith("NC"):
+                b = b.reshape((1, -1) + (1,) * n)
+            else:
+                b = b.reshape((1,) + (1,) * n + (-1,))
+            out = out + b.astype(out.dtype)
+        return out
+
+    args = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply(fn, *args, op_name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)
+
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]

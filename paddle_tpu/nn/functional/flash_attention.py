@@ -1,0 +1,9 @@
+"""paddle.nn.functional.flash_attention submodule parity (the reference
+exposes flash attention under this path too)."""
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention,
+    flash_attention,
+    flash_attn_unpadded,
+)
+
+flash_attn_qkvpacked = None  # packed variants land with the decode stack

@@ -1,0 +1,287 @@
+"""Loss functionals (reference surface: python/paddle/nn/functional/loss.py
+— unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(logits, lab, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lab_ = lab
+            if lab_.ndim == logp.ndim:  # trailing 1 dim form
+                lab_ = jnp.squeeze(lab_, axis=axis)
+            lab_i = lab_.astype(jnp.int32)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0.0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = -picked
+            if maybe_w:
+                w = maybe_w[0]
+                loss = loss * jnp.take(w, safe)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                if maybe_w:
+                    w = maybe_w[0]
+                    denom = jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0))
+                else:
+                    denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply(fn, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    out = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from .activation import softmax as softmax_fn
+
+    # paddle returns loss with a kept dim along axis
+    out = out.unsqueeze(axis)
+    if return_softmax:
+        return out, softmax_fn(logits, axis=axis)
+    return out
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(logp, lab, *maybe_w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, 1), axis=1
+        ).squeeze(1)
+        loss = -picked
+        if maybe_w:
+            loss = loss * jnp.take(maybe_w[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (
+                jnp.sum(jnp.where(valid, jnp.take(maybe_w[0], safe), 0.0))
+                if maybe_w
+                else jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            )
+            return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply(fn, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(
+        lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+        ensure_tensor(input), ensure_tensor(label), op_name="mse_loss",
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(
+        lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+        ensure_tensor(input), ensure_tensor(label), op_name="l1_loss",
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        # huber: 0.5*d^2 if |d|<delta else delta*(|d|-0.5*delta)
+        d = a - b
+        loss = jnp.where(
+            jnp.abs(d) < delta, 0.5 * d * d, delta * (jnp.abs(d) - 0.5 * delta)
+        )
+        return _reduce_loss(loss, reduction)
+
+    return apply(fn, ensure_tensor(input), ensure_tensor(label), op_name="smooth_l1")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce_loss(loss, reduction)
+
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply(fn, *args, op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|)), with
+        # pos_weight folded in the softplus term
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (
+                jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0)
+            )
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if pos_weight is not None:
+        args.append(ensure_tensor(pos_weight))
+    return apply(fn, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply(fn, ensure_tensor(input), ensure_tensor(label), op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(
+        lambda a, b, y: _reduce_loss(
+            jnp.maximum(-y * (a - b) + margin, 0.0), reduction
+        ),
+        ensure_tensor(input), ensure_tensor(other), ensure_tensor(label),
+        op_name="margin_ranking_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce_loss(loss, reduction)
+
+    return apply(
+        fn, ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label),
+        op_name="cosine_embedding_loss",
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        lambda x, y: _reduce_loss(
+            jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0)), reduction
+        ),
+        ensure_tensor(input), ensure_tensor(label),
+        op_name="hinge_embedding_loss",
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce_loss(loss, reduction)
+
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        args.append(ensure_tensor(normalizer))
+    return apply(fn, *args, op_name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    return apply(
+        lambda a, b: jnp.square(a - b),
+        ensure_tensor(input), ensure_tensor(label), op_name="square_error_cost",
+    )
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dsn = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1), 1 / p
+            )
+            dn = jnp.minimum(dn, dsn)
+        return _reduce_loss(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(
+        fn, ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative),
+        op_name="triplet_margin_loss",
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError(
+        "ctc_loss lands with the speech model family; out of round-1 scope"
+    )
+
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "cosine_embedding_loss", "hinge_embedding_loss", "sigmoid_focal_loss",
+    "square_error_cost", "triplet_margin_loss", "ctc_loss",
+]

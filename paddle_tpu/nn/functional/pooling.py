@@ -1,0 +1,201 @@
+"""Pooling functionals via lax.reduce_window (reference surface:
+python/paddle/nn/functional/pooling.py — unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+from .conv import _tuplize, _padding_arg
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, data_format,
+          ceil_mode=False, exclusive=True, count_include_pad=False, op="pool"):
+    ks = _tuplize(kernel, n)
+    st = _tuplize(stride if stride is not None else kernel, n)
+    pad = _padding_arg(padding, n)
+    channels_last = not data_format.startswith("NC")
+
+    def window_dims(v):
+        if channels_last:
+            return (1,) + ks + (1,), (1,) + st + (1,)
+        return (1, 1) + ks, (1, 1) + st
+
+    def pad_config(v):
+        if isinstance(pad, str):
+            if pad == "VALID":
+                sp = [(0, 0)] * n
+            else:  # SAME
+                sp = []
+                for i in range(n):
+                    dim = v.shape[2 + i] if not channels_last else v.shape[1 + i]
+                    out = -(-dim // st[i])
+                    total = max((out - 1) * st[i] + ks[i] - dim, 0)
+                    sp.append((total // 2, total - total // 2))
+        else:
+            sp = list(pad)
+        if ceil_mode:
+            sp2 = []
+            for i in range(n):
+                dim = v.shape[2 + i] if not channels_last else v.shape[1 + i]
+                eff = dim + sp[i][0] + sp[i][1]
+                rem = (eff - ks[i]) % st[i]
+                extra = (st[i] - rem) % st[i] if eff >= ks[i] else 0
+                sp2.append((sp[i][0], sp[i][1] + extra))
+            sp = sp2
+        if channels_last:
+            return [(0, 0)] + sp + [(0, 0)]
+        return [(0, 0), (0, 0)] + sp
+
+    def fn(v):
+        wd, ws = window_dims(v)
+        pc = pad_config(v)
+        if reducer == "max":
+            return jax.lax.reduce_window(
+                v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min,
+                jax.lax.max, wd, ws, pc,
+            )
+        # avg pooling
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, wd, ws, pc)
+        if exclusive and not count_include_pad:
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, wd, ws, pc)
+            return summed / counts
+        return summed / float(np.prod(ks))
+
+    return apply(fn, ensure_tensor(x), op_name=op)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    # operate as 2d with singleton dim
+    x = ensure_tensor(x)
+    out = _pool(x, kernel_size, stride, padding, 1, "max", None, data_format,
+                ceil_mode, op="max_pool1d")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", None, data_format,
+                ceil_mode, op="max_pool2d")
+    if return_mask:
+        idx = _pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", None, data_format,
+                 ceil_mode, op="max_pool3d")
+
+
+def _pool_indices(x, kernel_size, stride, padding, data_format):
+    """Argmax indices for return_mask (flat per-plane index, paddle style)."""
+    x = ensure_tensor(x)
+    ks = _tuplize(kernel_size, 2)
+    st = _tuplize(stride if stride is not None else kernel_size, 2)
+
+    def fn(v):
+        n_, c, h, w = v.shape
+        flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+        flat_idx = jnp.broadcast_to(flat_idx, v.shape)
+
+        def select(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        # reduce_window over pairs
+        init = (-jnp.inf, jnp.float32(-1))
+        vv, ii = jax.lax.reduce_window(
+            (v.astype(jnp.float32), flat_idx), init,
+            lambda a, b: select(a, b),
+            (1, 1) + ks, (1, 1) + st, "VALID",
+        )
+        return ii.astype(jnp.int32)
+
+    return apply(fn, x, op_name="max_pool2d_mask")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", None, data_format,
+                 ceil_mode, exclusive, op="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", None, data_format,
+                 ceil_mode, exclusive, op="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", None, data_format,
+                 ceil_mode, exclusive, op="avg_pool3d")
+
+
+def _adaptive_pool(x, output_size, n, mode, data_format, op):
+    x = ensure_tensor(x)
+    out_sizes = _tuplize(output_size, n)
+    channels_last = not data_format.startswith("NC")
+
+    def fn(v):
+        spatial_off = 1 if channels_last else 2
+        out = v
+        # adaptive pooling decomposes per spatial dim via mean/max of splits
+        for d in range(n):
+            dim = out.shape[spatial_off + d]
+            osz = out_sizes[d] if out_sizes[d] is not None else dim
+            # paddle adaptive: start = floor(i*dim/osz), end = ceil((i+1)*dim/osz)
+            starts = (np.arange(osz) * dim) // osz
+            ends = -(-(np.arange(1, osz + 1) * dim) // osz)
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=spatial_off + d)
+                red = (
+                    jnp.max(seg, axis=spatial_off + d, keepdims=True)
+                    if mode == "max"
+                    else jnp.mean(seg, axis=spatial_off + d, keepdims=True)
+                )
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=spatial_off + d)
+        return out
+
+    return apply(fn, x, op_name=op)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCL", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format, "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format, "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", "NCL", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW", "adaptive_max_pool3d")
+
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]

@@ -1,0 +1,209 @@
+"""Weight initializers (reference surface: python/paddle/nn/initializer/ —
+unverified, SURVEY.md §0). Each initializer is a callable
+``(shape, jax_dtype) -> jax array`` drawing from the global generator.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.random import next_key
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "set_global_initializer",
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]
+
+
+def _fans(shape):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+        fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+        if len(shape) == 2:
+            fan_in, fan_out = shape[0], shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(tuple(int(s) for s in shape), self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        sample_dt = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.float32
+        return self.mean + self.std * jax.random.normal(
+            next_key(), tuple(int(s) for s in shape), sample_dt
+        )
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=jnp.float32):
+        z = jax.random.truncated_normal(
+            next_key(), self.a, self.b, tuple(int(s) for s in shape), jnp.float32
+        )
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.uniform(
+            next_key(), tuple(int(s) for s in shape), jnp.float32,
+            minval=self.low, maxval=self.high,
+        ).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (
+            std * jax.random.normal(next_key(), tuple(int(s) for s in shape), jnp.float32)
+        ).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            next_key(), tuple(int(s) for s in shape), jnp.float32,
+            minval=-limit, maxval=limit,
+        ).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return (
+            std * jax.random.normal(next_key(), tuple(int(s) for s in shape), jnp.float32)
+        ).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(
+            next_key(), tuple(int(s) for s in shape), jnp.float32,
+            minval=-limit, maxval=limit,
+        ).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        from ...core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype)
+        if tuple(arr.shape) != tuple(int(s) for s in shape):
+            arr = arr.reshape(tuple(int(s) for s in shape))
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        shape = tuple(int(s) for s in shape)
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        n = max(rows, cols)
+        a = jax.random.normal(next_key(), (n, n), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=jnp.float32):
+        shape = tuple(int(s) for s in shape)
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = tuple(s // 2 for s in shape[2:])
+        for i in range(min(oc, ic)):
+            out[(i, i) + centers] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init

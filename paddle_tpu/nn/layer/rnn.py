@@ -1,0 +1,409 @@
+"""Recurrent layers via lax.scan — the compiler-friendly TPU recurrence
+(reference surface: python/paddle/nn/layer/rnn.py — unverified, SURVEY.md
+§0). Multi-layer/bidirectional LSTM/GRU/SimpleRNN with paddle's
+(outputs, final_states) contract.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .. import initializer as I
+from ...core.tensor import Tensor
+from ...core.dispatch import apply
+from ...tensor._helpers import ensure_tensor
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN",
+]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+
+        b = batch_ref.shape[batch_dim_idx]
+        state_shape = [b, self.hidden_size]
+        if isinstance(self.state_shape, tuple):
+            return tuple(
+                full(state_shape, init_value, dtype or "float32")
+                for _ in self.state_shape
+            )
+        return full(state_shape, init_value, dtype or "float32")
+
+
+def _cell_params(layer, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+    k = 1.0 / math.sqrt(hidden_size)
+    layer.weight_ih = layer.create_parameter(
+        (n_gates * hidden_size, input_size), attr=weight_ih_attr,
+        default_initializer=I.Uniform(-k, k),
+    )
+    layer.weight_hh = layer.create_parameter(
+        (n_gates * hidden_size, hidden_size), attr=weight_hh_attr,
+        default_initializer=I.Uniform(-k, k),
+    )
+    layer.bias_ih = (
+        layer.create_parameter(
+            (n_gates * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-k, k),
+        )
+        if bias_ih_attr is not False
+        else None
+    )
+    layer.bias_hh = (
+        layer.create_parameter(
+            (n_gates * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-k, k),
+        )
+        if bias_hh_attr is not False
+        else None
+    )
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.state_shape = (hidden_size,)
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def step_fn(self):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        has_bi, has_bh = self.bias_ih is not None, self.bias_hh is not None
+
+        def step(x, h, w_ih, w_hh, b_ih, b_hh):
+            z = x @ w_ih.T + h @ w_hh.T
+            if has_bi:
+                z = z + b_ih
+            if has_bh:
+                z = z + b_hh
+            return act(z)
+
+        return step
+
+    def _param_values(self):
+        return (
+            self.weight_ih, self.weight_hh,
+            self.bias_ih, self.bias_hh,
+        )
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+        step = self.step_fn()
+        args = [ensure_tensor(inputs), ensure_tensor(states)]
+        params = [p for p in self._param_values() if p is not None]
+
+        def fn(x, h, *ps):
+            ps = list(ps)
+            w_ih, w_hh = ps[0], ps[1]
+            b_ih = ps[2] if self.bias_ih is not None else None
+            b_hh = ps[3 if self.bias_ih is not None else 2] if self.bias_hh is not None else None
+            out = step(x, h, w_ih, w_hh, b_ih, b_hh)
+            return out, out
+
+        out = apply(fn, *args, *params, op_name="simple_rnn_cell")
+        return out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.state_shape = ((hidden_size,), (hidden_size,))
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @staticmethod
+    def _compute(x, h, c, w_ih, w_hh, b_ih, b_hh, hidden_size):
+        z = x @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            z = z + b_ih
+        if b_hh is not None:
+            z = z + b_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+        h, c = states
+        params = [self.weight_ih, self.weight_hh]
+        nb = 0
+        if self.bias_ih is not None:
+            params.append(self.bias_ih)
+            nb += 1
+        if self.bias_hh is not None:
+            params.append(self.bias_hh)
+
+        def fn(x, hv, cv, w_ih, w_hh, *bs):
+            b_ih = bs[0] if self.bias_ih is not None else None
+            b_hh = bs[-1] if self.bias_hh is not None else None
+            h_new, c_new = LSTMCell._compute(
+                x, hv, cv, w_ih, w_hh, b_ih, b_hh, self.hidden_size
+            )
+            return h_new, (h_new, c_new)
+
+        return apply(
+            fn, ensure_tensor(inputs), ensure_tensor(h), ensure_tensor(c),
+            *params, op_name="lstm_cell",
+        )
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.state_shape = (hidden_size,)
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @staticmethod
+    def _compute(x, h, w_ih, w_hh, b_ih, b_hh):
+        gi = x @ w_ih.T
+        gh = h @ w_hh.T
+        if b_ih is not None:
+            gi = gi + b_ih
+        if b_hh is not None:
+            gh = gh + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        return (1 - z) * n + z * h
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+        params = [self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            params.append(self.bias_ih)
+        if self.bias_hh is not None:
+            params.append(self.bias_hh)
+
+        def fn(x, hv, w_ih, w_hh, *bs):
+            b_ih = bs[0] if self.bias_ih is not None else None
+            b_hh = bs[-1] if self.bias_hh is not None else None
+            out = GRUCell._compute(x, hv, w_ih, w_hh, b_ih, b_hh)
+            return out, out
+
+        return apply(
+            fn, ensure_tensor(inputs), ensure_tensor(states), *params,
+            op_name="gru_cell",
+        )
+
+
+class RNN(Layer):
+    """Runs a cell over a sequence with lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        cell = self.cell
+        if initial_states is None:
+            ref = inputs if self.time_major else inputs
+            b = ref.shape[1] if self.time_major else ref.shape[0]
+            from ...tensor.creation import zeros
+
+            if isinstance(cell.state_shape, tuple) and isinstance(
+                cell.state_shape[0], tuple
+            ):
+                initial_states = tuple(
+                    zeros([b, cell.hidden_size], dtype="float32")
+                    for _ in cell.state_shape
+                )
+            else:
+                initial_states = zeros([b, cell.hidden_size], dtype="float32")
+
+        is_lstm = isinstance(cell, LSTMCell)
+        params = [cell.weight_ih, cell.weight_hh]
+        if cell.bias_ih is not None:
+            params.append(cell.bias_ih)
+        if cell.bias_hh is not None:
+            params.append(cell.bias_hh)
+        has_bi = cell.bias_ih is not None
+        has_bh = cell.bias_hh is not None
+        time_major, is_reverse = self.time_major, self.is_reverse
+        if is_lstm:
+            state_args = [ensure_tensor(initial_states[0]), ensure_tensor(initial_states[1])]
+        else:
+            state_args = [ensure_tensor(initial_states)]
+
+        cell_type = type(cell)
+
+        def fn(x, *rest):
+            n_states = 2 if is_lstm else 1
+            states = rest[:n_states]
+            ps = rest[n_states:]
+            w_ih, w_hh = ps[0], ps[1]
+            b_ih = ps[2] if has_bi else None
+            b_hh = ps[2 + (1 if has_bi else 0)] if has_bh else None
+            seq = x if time_major else jnp.swapaxes(x, 0, 1)
+            if is_reverse:
+                seq = jnp.flip(seq, 0)
+
+            if is_lstm:
+                def step(carry, xt):
+                    h, c = carry
+                    h2, c2 = LSTMCell._compute(xt, h, c, w_ih, w_hh, b_ih, b_hh, cell.hidden_size)
+                    return (h2, c2), h2
+
+                carry, outs = jax.lax.scan(step, (states[0], states[1]), seq)
+                final = carry
+            elif cell_type is GRUCell:
+                def step(h, xt):
+                    h2 = GRUCell._compute(xt, h, w_ih, w_hh, b_ih, b_hh)
+                    return h2, h2
+
+                final, outs = jax.lax.scan(step, states[0], seq)
+                final = (final,)
+            else:
+                act = jnp.tanh if getattr(cell, "activation", "tanh") == "tanh" else jax.nn.relu
+
+                def step(h, xt):
+                    z = xt @ w_ih.T + h @ w_hh.T
+                    if b_ih is not None:
+                        z = z + b_ih
+                    if b_hh is not None:
+                        z = z + b_hh
+                    h2 = act(z)
+                    return h2, h2
+
+                final, outs = jax.lax.scan(step, states[0], seq)
+                final = (final,)
+            if is_reverse:
+                outs = jnp.flip(outs, 0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs, *final)
+
+        result = apply(fn, ensure_tensor(inputs), *state_args, *params, op_name="rnn")
+        outs = result[0]
+        if is_lstm:
+            return outs, (result[1], result[2])
+        return outs, result[1]
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+
+        states_fw = states_bw = None
+        if initial_states is not None:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode, self.num_layers = mode, num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.hidden_size = hidden_size
+
+        def make_cell(isz):
+            if mode == "LSTM":
+                return LSTMCell(isz, hidden_size, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            if mode == "GRU":
+                return GRUCell(isz, hidden_size, weight_ih_attr,
+                               weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(isz, hidden_size, activation, weight_ih_attr,
+                                 weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+        from .common import LayerList
+
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            isz = input_size if layer_i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                self.rnns.append(BiRNN(make_cell(isz), make_cell(isz), time_major))
+            else:
+                self.rnns.append(RNN(make_cell(isz), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        from ...tensor.manipulation import stack
+
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.rnns):
+            out, st = rnn(out, None, sequence_length)
+            finals.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        # assemble final states in paddle layout (num_layers*dirs, B, H)
+        if self.mode == "LSTM":
+            if self.num_directions == 1:
+                h = stack([st[0] for st in finals], axis=0)
+                c = stack([st[1] for st in finals], axis=0)
+            else:
+                hs, cs = [], []
+                for st_fw, st_bw in finals:
+                    hs += [st_fw[0], st_bw[0]]
+                    cs += [st_fw[1], st_bw[1]]
+                h, c = stack(hs, axis=0), stack(cs, axis=0)
+            return out, (h, c)
+        if self.num_directions == 1:
+            h = stack(finals, axis=0)
+        else:
+            hs = []
+            for st_fw, st_bw in finals:
+                hs += [st_fw, st_bw]
+            h = stack(hs, axis=0)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

@@ -1,0 +1,10 @@
+"""paddle.optimizer namespace."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb, LarsMomentum,
+)
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+from .optimizer import L1Decay, L2Decay  # noqa: F401

@@ -1,0 +1,60 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py — unverified,
+SURVEY.md §0). Clips operate on (param, grad) value lists inside the
+jitted update, multi-tensor style."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def clip_values(self, grads):
+        """grads: list of jax arrays → clipped list (used inside jit)."""
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        """Eager API: list of (param Tensor, grad Tensor) pairs."""
+        from ..core.tensor import Tensor
+
+        grads = [g._value for _, g in params_grads]
+        clipped = self.clip_values(grads)
+        return [
+            (p, Tensor(g, stop_gradient=True))
+            for (p, _), g in zip(params_grads, clipped)
+        ]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def clip_values(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def clip_values(self, grads):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.where(
+                norm > self.clip_norm, self.clip_norm / jnp.maximum(norm, 1e-12), 1.0
+            )
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def clip_values(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]

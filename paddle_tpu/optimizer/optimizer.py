@@ -1,0 +1,460 @@
+"""Optimizers (reference surface: python/paddle/optimizer/ — unverified,
+SURVEY.md §0).
+
+Design: each optimizer defines a pure per-tensor ``_update(p, g, state,
+lr)`` rule; ``step()`` runs ONE jitted multi-tensor update over all
+params/grads/accumulators — the TPU-native analog of the reference's
+``fused_adam`` multi-tensor kernels (paddle/phi/kernels/fused_adam_kernel
+— a single compiled XLA program updates every parameter). The same pure
+rule is reused by the distributed trainer through
+``functional_state_init`` / ``functional_apply``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import autograd
+from .lr import LRScheduler
+from .clip import ClipGradBase
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "LarsMomentum",
+]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+def _wd_coeff(weight_decay):
+    if weight_decay is None:
+        return 0.0, "l2"
+    if isinstance(weight_decay, L2Decay):
+        return weight_decay.coeff, "l2"
+    if isinstance(weight_decay, L1Decay):
+        return weight_decay.coeff, "l1"
+    return float(weight_decay), "l2"
+
+
+class Optimizer:
+    _decoupled_wd = False  # AdamW-style
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None, **kwargs):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._wd, self._wd_kind = _wd_coeff(weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._states: dict[int, dict] = {}
+        self._step_count = 0
+        self._jitted = None
+        self._jit_shapes = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -- state ---------------------------------------------------------------
+    def _init_state(self, p_value):
+        """Return dict of accumulator arrays for one param (pure)."""
+        return {}
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        """Pure per-tensor update: returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    def _decay_enabled(self, param) -> bool:
+        """Per-param weight-decay gate (AdamW apply_decay_param_fun etc.)."""
+        return True
+
+    def _state_for(self, param):
+        key = id(param)
+        if key not in self._states:
+            st = self._init_state(param._value)
+            if self._multi_precision and param._value.dtype in (
+                jnp.float16, jnp.bfloat16
+            ):
+                st["master"] = param._value.astype(jnp.float32)
+            self._states[key] = st
+        return self._states[key]
+
+    # -- functional bridge (used by fleet/hapi jitted train steps) ----------
+    def functional_state_init(self, params_tree):
+        """Pytree of param arrays → pytree of state dicts."""
+        return jax.tree_util.tree_map(
+            lambda p: self._init_state(p), params_tree,
+            is_leaf=lambda x: isinstance(x, jax.Array),
+        )
+
+    def functional_apply(self, params_tree, grads_tree, states_tree, lr, step):
+        """Pure pytree update (no Tensor objects) for jitted trainers."""
+
+        def upd(p, g, st):
+            return self._apply_one(p, g, st, lr, step)
+
+        flat_p, tdef = jax.tree_util.tree_flatten(
+            params_tree, is_leaf=lambda x: isinstance(x, jax.Array)
+        )
+        flat_g = tdef.flatten_up_to(grads_tree)
+        flat_s = tdef.flatten_up_to(states_tree)
+        if self._grad_clip is not None:
+            flat_g = self._grad_clip.clip_values(flat_g)
+        new = [upd(p, g, st) for p, g, st in zip(flat_p, flat_g, flat_s)]
+        new_p = jax.tree_util.tree_unflatten(tdef, [x[0] for x in new])
+        new_s = jax.tree_util.tree_unflatten(tdef, [x[1] for x in new])
+        return new_p, new_s
+
+    def _apply_one(self, p, g, state, lr, step, decay=True):
+        """Full per-tensor update incl. weight decay + master weights."""
+        work = state.get("master", p)
+        g = g.astype(work.dtype)
+        if self._wd and not self._decoupled_wd and decay:
+            if self._wd_kind == "l2":
+                g = g + self._wd * work
+            else:
+                g = g + self._wd * jnp.sign(work)
+        new_work, new_state = self._update(
+            work, g, {k: v for k, v in state.items() if k != "master"},
+            lr, step, decay=decay,
+        )
+        if self._wd and self._decoupled_wd and decay:
+            new_work = new_work - lr * self._wd * work
+        if "master" in state:
+            new_state["master"] = new_work
+            return new_work.astype(p.dtype), new_state
+        return new_work, new_state
+
+    # -- eager step ----------------------------------------------------------
+    @autograd.no_grad()
+    def step(self):
+        params = [
+            p
+            for p in (self._parameter_list or [])
+            if p.trainable and p.grad is not None
+        ]
+        if not params:
+            return
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self._step_count + 1, jnp.int32)
+        p_vals = [p._value for p in params]
+        g_vals = [p.grad._value for p in params]
+        s_vals = [self._state_for(p) for p in params]
+        decay_flags = tuple(self._decay_enabled(p) for p in params)
+
+        shapes = (tuple((v.shape, str(v.dtype)) for v in p_vals), decay_flags)
+        if self._jitted is None or self._jit_shapes != shapes:
+            def fused(ps, gs, ss, lr_, st_):
+                if self._grad_clip is not None:
+                    gs = self._grad_clip.clip_values(gs)
+                outs = [
+                    self._apply_one(p, g, s, lr_, st_, decay=d)
+                    for p, g, s, d in zip(ps, gs, ss, decay_flags)
+                ]
+                return [o[0] for o in outs], [o[1] for o in outs]
+
+            self._jitted = jax.jit(fused)
+            self._jit_shapes = shapes
+
+        new_p, new_s = self._jitted(p_vals, g_vals, s_vals, lr, step_no)
+        for p, np_, ns in zip(params, new_p, new_s):
+            p._value = np_
+            self._states[id(p)] = ns
+        self._step_count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- serialization -------------------------------------------------------
+    def state_dict(self):
+        out = {"step_count": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._parameter_list or []):
+            st = self._states.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name}_{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(dict(state_dict["LR_Scheduler"]))
+        for p in self._parameter_list or []:
+            st = self._state_for(p)
+            for k in list(st):
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p_value):
+        return {"velocity": jnp.zeros(p_value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        v = self._momentum * state["velocity"] + g.astype(jnp.float32)
+        if self._nesterov:
+            upd = g.astype(jnp.float32) + self._momentum * v
+        else:
+            upd = v
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p_value):
+        return {
+            "moment1": jnp.zeros(p_value.shape, jnp.float32),
+            "moment2": jnp.zeros(p_value.shape, jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - self._beta1**t)
+        v_hat = v / (1 - self._beta2**t)
+        new_p = p.astype(jnp.float32) - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_enabled(self, param) -> bool:
+        if self._apply_decay_param_fun is None:
+            return True
+        return bool(self._apply_decay_param_fun(param.name))
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p_value):
+        return {
+            "moment": jnp.zeros(p_value.shape, jnp.float32),
+            "inf_norm": jnp.zeros(p_value.shape, jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        t = step.astype(jnp.float32)
+        new_p = p.astype(jnp.float32) - (lr / (1 - self._beta1**t)) * m / (u + self._eps)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p_value):
+        return {"moment": jnp.full(p_value.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        acc = state["moment"] + jnp.square(g32)
+        new_p = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, p_value):
+        return {
+            "avg_squared_grad": jnp.zeros(p_value.shape, jnp.float32),
+            "avg_squared_update": jnp.zeros(p_value.shape, jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(g32)
+        upd = (
+            jnp.sqrt(state["avg_squared_update"] + self._eps)
+            / jnp.sqrt(asg + self._eps)
+        ) * g32
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        new_p = p.astype(jnp.float32) - lr * upd
+        return new_p.astype(p.dtype), {
+            "avg_squared_grad": asg,
+            "avg_squared_update": asu,
+        }
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p_value):
+        st = {
+            "mean_square": jnp.zeros(p_value.shape, jnp.float32),
+            "momentum_acc": jnp.zeros(p_value.shape, jnp.float32),
+        }
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(p_value.shape, jnp.float32)
+        return st
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum_acc"] + lr * g32 / denom
+        new_state["momentum_acc"] = mom
+        new_p = p.astype(jnp.float32) - mom
+        return new_p.astype(p.dtype), new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p_value):
+        return {
+            "moment1": jnp.zeros(p_value.shape, jnp.float32),
+            "moment2": jnp.zeros(p_value.shape, jnp.float32),
+        }
+
+    def _decay_enabled(self, param) -> bool:
+        if self._exclude_fn is None:
+            return True
+        return not bool(self._exclude_fn(param))
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - self._beta1**t)
+        v_hat = v / (1 - self._beta2**t)
+        wd = self._lamb_wd if decay else 0.0
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where(
+            (w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0
+        )
+        new_p = p32 - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, False, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _init_state(self, p_value):
+        return {"velocity": jnp.zeros(p_value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + 1e-12),
+            1.0,
+        )
+        v = self._momentum * state["velocity"] + local_lr * lr * (
+            g32 + self._lars_wd * p32
+        )
+        return (p32 - v).astype(p.dtype), {"velocity": v}

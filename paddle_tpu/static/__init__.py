@@ -1,0 +1,94 @@
+"""paddle.static facade (reference: python/paddle/static/ — unverified,
+SURVEY.md §0). The static-graph *runtime* is XLA; this namespace keeps the
+API surface: InputSpec for jit.save, Program handles as thin shims, and
+save/load_inference_model over the jit.save format.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import dtype as _dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "InputSpec", "Program", "default_main_program", "default_startup_program",
+    "program_guard", "save_inference_model", "load_inference_model", "gradients",
+]
+
+
+class InputSpec:
+    """Shape/dtype declaration (None = dynamic dim)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = _dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    """Thin Program shim: under XLA there is no mutable ProgramDesc; jitted
+    StaticFunctions own their lowered modules (see jit.StaticFunction
+    .get_stablehlo)."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    raise NotImplementedError(
+        "static-graph save_inference_model: use paddle.jit.save (StableHLO export)"
+    )
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "static-graph load_inference_model: use paddle.jit.load"
+    )
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad
+
+    return grad(targets, inputs, target_gradients, allow_unused=True)

@@ -1,0 +1,112 @@
+"""paddle.vision.datasets (reference: python/paddle/vision/datasets/ —
+unverified, SURVEY.md §0). Zero-egress environment: downloads are not
+possible, so MNIST/Cifar load from a user-provided local path, and
+``FakeData`` provides synthetic images for pipelines/benchmarks (the
+pattern the reference's tests use for speed).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Synthetic image dataset: deterministic per-index samples."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rs = np.random.RandomState(idx)
+        img = rs.standard_normal(self.image_shape).astype(self.dtype)
+        label = rs.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx-gz files (image_path/label_path)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or not os.path.exists(image_path)):
+            raise RuntimeError(
+                "download unavailable (zero-egress); pass image_path/label_path"
+            )
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            raise FileNotFoundError(
+                "MNIST files not found; pass image_path and label_path, or "
+                "use paddle.vision.datasets.FakeData for synthetic data"
+            )
+        with gzip.open(image_path, "rb") as f:
+            data = f.read()
+        self.images = np.frombuffer(data, np.uint8, offset=16).reshape(-1, 28, 28)
+        with gzip.open(label_path, "rb") as f:
+            data = f.read()
+        self.labels = np.frombuffer(data, np.uint8, offset=8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version tar.gz."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "Cifar10 archive not found; pass data_file, or use FakeData"
+            )
+        self.transform = transform
+        images, labels = [], []
+        prefix = "data_batch" if mode == "train" else "test_batch"
+        with tarfile.open(data_file) as tar:
+            for member in tar.getmembers():
+                if prefix in member.name:
+                    batch = pickle.load(tar.extractfile(member), encoding="bytes")
+                    images.append(batch[b"data"])
+                    labels.extend(batch.get(b"labels", batch.get(b"fine_labels")))
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    pass

@@ -1,0 +1,34 @@
+"""LeNet (reference: python/paddle/vision/models/lenet.py)."""
+from ...nn.layer.layers import Layer
+from ...nn.layer import common as C
+from ...nn.layer import conv as CV
+from ...nn.layer import norm as N
+
+__all__ = ["LeNet"]
+
+
+class LeNet(Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = C.Sequential(
+            CV.Conv2D(1, 6, 3, stride=1, padding=1),
+            C.ReLU(),
+            N.MaxPool2D(2, 2),
+            CV.Conv2D(6, 16, 5, stride=1, padding=0),
+            C.ReLU(),
+            N.MaxPool2D(2, 2),
+        )
+        if num_classes > 0:
+            self.fc = C.Sequential(
+                C.Linear(400, 120), C.Linear(120, 84), C.Linear(84, num_classes)
+            )
+
+    def forward(self, inputs):
+        x = self.features(inputs)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x

@@ -1,0 +1,260 @@
+"""paddle.vision.transforms (reference: python/paddle/vision/transforms/ —
+unverified, SURVEY.md §0). Numpy/PIL-free implementations operating on
+HWC uint8/float arrays (and CHW tensors where noted).
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "BrightnessTransform", "to_tensor", "normalize",
+    "resize", "hflip", "vflip", "center_crop", "crop",
+]
+
+
+def _as_numpy(img):
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _as_numpy(pic)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr.astype(np.float32))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _as_numpy(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    if isinstance(img, Tensor):
+        return Tensor(arr)
+    return arr
+
+
+def resize(img, size, interpolation="bilinear"):
+    """HWC resize via jax.image (no PIL dependency)."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = _as_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[
+        interpolation
+    ]
+    out = jax.image.resize(
+        jnp.asarray(arr, jnp.float32), (oh, ow, arr.shape[2]), method=method
+    )
+    out = np.asarray(out)
+    if arr.dtype == np.uint8 if hasattr(arr, "dtype") else False:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    if squeeze:
+        out = out[:, :, 0]
+    return out
+
+
+def crop(img, top, left, height, width):
+    arr = _as_numpy(img)
+    return arr[top : top + height, left : left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_numpy(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_numpy(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_numpy(img)[::-1]
+
+
+class BaseTransform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        self.mean = mean if not isinstance(mean, numbers.Number) else [mean] * 3
+        self.std = std if not isinstance(std, numbers.Number) else [std] * 3
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _as_numpy(img)
+        if self.padding:
+            p = self.padding
+            arr = np.pad(arr, ((p, p), (p, p)) + ((0, 0),) * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(arr, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = _as_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            aspect = random.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target_area * aspect)))
+            th = int(round(np.sqrt(target_area / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = random.randint(0, h - th)
+                left = random.randint(0, w - tw)
+                patch = crop(arr, top, left, th, tw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return hflip(img)
+        return _as_numpy(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return vflip(img)
+        return _as_numpy(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _as_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = _as_numpy(img)
+        p = self.padding
+        if isinstance(p, int):
+            widths = ((p, p), (p, p))
+        elif len(p) == 2:
+            widths = ((p[1], p[1]), (p[0], p[0]))
+        else:
+            widths = ((p[1], p[3]), (p[0], p[2]))
+        widths = widths + ((0, 0),) * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(arr, widths, constant_values=self.fill)
+        return np.pad(arr, widths, mode=self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = _as_numpy(img).astype(np.float32)
+        factor = 1 + random.uniform(-self.value, self.value)
+        out = arr * factor
+        if _as_numpy(img).dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out

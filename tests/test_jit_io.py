@@ -1,0 +1,161 @@
+"""to_static, save/load, DataLoader tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import Dataset, IterableDataset, DataLoader, TensorDataset
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_to_static_matches_eager():
+    net = Net()
+    x = paddle.randn([3, 4])
+    y0 = net(x).numpy()
+    snet = paddle.jit.to_static(net)
+    y1 = snet(x).numpy()
+    np.testing.assert_allclose(y0, y1, rtol=1e-6)
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return a * b + a
+
+    out = f(paddle.ones([2]), paddle.to_tensor([2.0, 3.0]))
+    np.testing.assert_allclose(out.numpy(), [3.0, 4.0])
+
+
+def test_to_static_grad_flow():
+    net = Net()
+    snet = paddle.jit.to_static(net)
+    x = paddle.randn([3, 4])
+    loss = snet(x).sum()
+    loss.backward()
+    assert net.fc1.weight.grad is not None
+    # grads match eager
+    g_static = net.fc1.weight.grad.numpy().copy()
+    net.clear_gradients()
+    paddle.jit.enable_to_static(False)
+    try:
+        net(x).sum().backward()
+    finally:
+        paddle.jit.enable_to_static(True)
+    np.testing.assert_allclose(g_static, net.fc1.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_to_static_retrace_on_shape_change():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(a):
+        calls.append(1)
+        return a * 2
+
+    f(paddle.ones([2]))
+    f(paddle.ones([2]))  # cached: no retrace
+    f(paddle.ones([3]))  # new shape: retrace
+    assert len(calls) == 2
+
+
+def test_stablehlo_export():
+    net = Net()
+    snet = paddle.jit.to_static(net)
+    hlo = snet.forward.get_stablehlo(paddle.randn([2, 4])) if hasattr(
+        snet.forward, "get_stablehlo"
+    ) else snet(paddle.randn([2, 4]))  # exercise either path
+    # direct function form
+    sf = paddle.jit.to_static(Net())
+    text = sf.forward.get_stablehlo(paddle.randn([2, 4]))
+    assert "stablehlo" in text or "module" in text
+
+
+def test_jit_save_load(tmp_path):
+    net = Net()
+    net.eval()
+    x = paddle.randn([2, 4])
+    y_ref = net(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[paddle.static.InputSpec([2, 4])])
+    loaded = paddle.jit.load(path)
+    y = loaded(x)
+    np.testing.assert_allclose(y.numpy(), y_ref, rtol=1e-5)
+
+
+def test_paddle_save_load_nested(tmp_path):
+    obj = {
+        "model": Net().state_dict(),
+        "step": 7,
+        "lr": [0.1, 0.2],
+    }
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    assert loaded["step"] == 7
+    k = list(obj["model"])[0]
+    np.testing.assert_allclose(
+        loaded["model"][k].numpy(), obj["model"][k].numpy()
+    )
+
+
+def test_dataloader_map_dataset():
+    class DS(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32), i
+
+    dl = DataLoader(DS(), batch_size=3, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == [3, 2]
+    assert batches[0][1].numpy().tolist() == [0, 1, 2]
+
+
+def test_dataloader_shuffle_covers_all():
+    class DS(Dataset):
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            return i
+
+    dl = DataLoader(DS(), batch_size=4, shuffle=True)
+    seen = []
+    for b in dl:
+        seen.extend(b.numpy().tolist())
+    assert sorted(seen) == list(range(20))
+
+
+def test_dataloader_iterable_and_workers():
+    class IDS(IterableDataset):
+        def __iter__(self):
+            yield from (np.float32(i) for i in range(7))
+
+    dl = DataLoader(IDS(), batch_size=2, num_workers=2)
+    out = [b.numpy().tolist() for b in dl]
+    assert out == [[0, 1], [2, 3], [4, 5], [6]]
+
+
+def test_tensor_dataset():
+    xs = paddle.randn([6, 3])
+    ys = paddle.arange(6)
+    ds = TensorDataset([xs, ys])
+    x0, y0 = ds[2]
+    np.testing.assert_allclose(x0.numpy(), xs.numpy()[2])
+    dl = DataLoader(ds, batch_size=2)
+    bx, by = next(iter(dl))
+    assert bx.shape == [2, 3]

@@ -1,0 +1,231 @@
+"""nn.Layer system + layer zoo tests (reference test analog:
+test/legacy_test layer tests — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 3)
+            self.w = self.create_parameter([2, 2])
+            self.register_buffer("buf", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    names = [n for n, _ in m.named_parameters()]
+    assert set(names) == {"fc.weight", "fc.bias", "w"}
+    sd = m.state_dict()
+    assert "buf" in sd
+    assert len(m.sublayers()) == 1
+
+
+def test_set_state_dict_shape_check():
+    m = nn.Linear(2, 3)
+    sd = m.state_dict()
+    sd2 = {k: v.numpy() for k, v in sd.items()}
+    sd2["weight"] = np.zeros((3, 3), np.float32)
+    with pytest.raises(ValueError):
+        m.set_state_dict(sd2)
+
+
+def test_train_eval_propagates():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    m.eval()
+    assert not m[1].training
+    m.train()
+    assert m[1].training
+
+
+def test_linear_matches_numpy():
+    m = nn.Linear(4, 3)
+    x = np.random.randn(5, 4).astype(np.float32)
+    out = m(paddle.to_tensor(x))
+    ref = x @ m.weight.numpy() + m.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_matches_torch_style_ref():
+    # oracle: scipy-free direct conv via numpy
+    m = nn.Conv2D(2, 3, 3, padding=1)
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    out = m(paddle.to_tensor(x))
+    assert out.shape == [1, 3, 5, 5]
+    # numeric check against explicit loop conv
+    w, b = m.weight.numpy(), m.bias.numpy()
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref = np.zeros((1, 3, 5, 5), np.float32)
+    for oc in range(3):
+        for i in range(5):
+            for j in range(5):
+                ref[0, oc, i, j] = (
+                    xp[0, :, i : i + 3, j : j + 3] * w[oc]
+                ).sum() + b[oc]
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm1D(4)
+    x = paddle.randn([16, 4])
+    bn.train()
+    y = bn(x)
+    # batch-normalized output: near zero mean, unit var
+    np.testing.assert_allclose(y.numpy().mean(0), 0, atol=1e-5)
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [16, 4]
+
+
+def test_layernorm_vs_numpy():
+    ln = nn.LayerNorm(8)
+    x = np.random.randn(3, 8).astype(np.float32)
+    out = ln(paddle.to_tensor(x))
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsnorm_vs_numpy():
+    m = nn.RMSNorm(8)
+    x = np.random.randn(2, 8).astype(np.float32)
+    out = m(paddle.to_tensor(x))
+    ref = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(paddle.to_tensor([[0, 1], [2, 0]]))
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], 0)
+    np.testing.assert_allclose(out.numpy()[1, 1], 0)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    y = d(x)
+    kept = (y.numpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)  # upscale
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_adaptive_avg_pool():
+    x = paddle.randn([2, 3, 7, 7])
+    out = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(
+        out.numpy().squeeze(), x.numpy().mean((2, 3)), rtol=1e-5
+    )
+
+
+def test_cross_entropy_matches_manual():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 4, 1])
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, -100, 4, -100])
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2], [0, 4]]).mean()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_multi_head_attention_shapes():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 6, 16])
+    out = mha(q)
+    assert out.shape == [2, 6, 16]
+
+
+def test_mha_cache_decode():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 1, 16])
+    cache = mha.gen_cache(x)
+    out, cache = mha(x, x, x, cache=cache)
+    assert cache.k.shape[1] == 1
+    out, cache = mha(x, x, x, cache=cache)
+    assert cache.k.shape[1] == 2
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_lstm_grad_flows():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    out, (h, c) = lstm(x)
+    out.sum().backward()
+    cell = lstm.rnns[0].cell
+    assert cell.weight_ih.grad is not None
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(("a", nn.Linear(2, 2)), ("b", nn.ReLU()))
+    assert len(s) == 2
+    out = s(paddle.ones([1, 2]))
+    assert out.shape == [1, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_forward_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    m(paddle.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    m(paddle.ones([1, 2]))
+    assert calls == [1]
+
+
+def test_interpolate_nearest():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert out.shape == [1, 1, 4, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0, :2, :2], 0)
+
+
+def test_scaled_dot_product_attention_causal():
+    q = paddle.randn([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+    # position 0 attends only to itself → equals v[0]
+    np.testing.assert_allclose(
+        out.numpy()[0, 0], q.numpy()[0, 0], rtol=1e-4, atol=1e-5
+    )

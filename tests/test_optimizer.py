@@ -1,0 +1,159 @@
+"""Optimizer + LR scheduler + clip + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def quad_minimize(opt_factory, steps=150, tol=0.1):
+    p = paddle.to_tensor([0.0, 0.0], stop_gradient=False)
+    opt = opt_factory([p])
+    for _ in range(steps):
+        loss = ((p - paddle.to_tensor([3.0, -2.0])) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(p.numpy(), [3.0, -2.0], atol=tol)
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda ps: paddle.optimizer.SGD(0.1, parameters=ps),
+        lambda ps: paddle.optimizer.Momentum(0.05, 0.9, parameters=ps),
+        lambda ps: paddle.optimizer.Adam(0.3, parameters=ps),
+        lambda ps: paddle.optimizer.AdamW(0.3, parameters=ps, weight_decay=0.0),
+        lambda ps: paddle.optimizer.RMSProp(0.1, parameters=ps),
+        lambda ps: paddle.optimizer.Adagrad(0.9, parameters=ps),
+        lambda ps: paddle.optimizer.Adamax(0.3, parameters=ps),
+        lambda ps: paddle.optimizer.Lamb(0.1, lamb_weight_decay=0.0, parameters=ps),
+    ],
+)
+def test_optimizers_converge(factory):
+    quad_minimize(factory)
+
+
+def test_adam_matches_reference_formula():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.Adam(0.1, parameters=[p])
+    (p * 3.0).sum().backward()
+    opt.step()
+    # one Adam step with g=3: m=0.3*? — closed form below
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.1
+    g = 3.0
+    m = (1 - b1) * g
+    v = (1 - b2) * g * g
+    m_hat = m / (1 - b1)
+    v_hat = v / (1 - b2)
+    expect = 1.0 - lr * m_hat / (np.sqrt(v_hat) + eps)
+    np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.SGD(0.1, parameters=[p], weight_decay=0.5)
+    paddle.to_tensor([0.0])
+    (p * 0.0).sum().backward()
+    opt.step()
+    # grad = 0 + wd*p = 0.5 → p = 1 - 0.1*0.5
+    np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.AdamW(0.1, parameters=[p], weight_decay=0.1)
+    (p * 0.0).sum().backward()
+    opt.step()
+    # zero grad → only decoupled decay: p -= lr*wd*p
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.1 * 1.0], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    p1 = paddle.to_tensor([3.0], stop_gradient=False)
+    p2 = paddle.to_tensor([4.0], stop_gradient=False)
+    clip = paddle.nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(1.0, parameters=[p1, p2], grad_clip=clip)
+    (p1 * 3.0 + p2 * 4.0).sum().backward()
+    opt.step()
+    # grads (3,4): global norm 5 → scaled by 1/5 → (0.6, 0.8)
+    np.testing.assert_allclose(p1.numpy(), [3.0 - 0.6], rtol=1e-5)
+    np.testing.assert_allclose(p2.numpy(), [4.0 - 0.8], rtol=1e-5)
+
+
+def test_lr_scheduler_step():
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.SGD(sched, parameters=[p])
+    lrs = []
+    for i in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+
+def test_cosine_warmup_schedulers():
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    vals = []
+    for _ in range(11):
+        vals.append(cos())
+        cos.step()
+    np.testing.assert_allclose(vals[0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(vals[10], 0.0, atol=1e-6)
+    warm = paddle.optimizer.lr.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0, end_lr=0.5)
+    ws = []
+    for _ in range(6):
+        ws.append(warm())
+        warm.step()
+    np.testing.assert_allclose(ws[:5], [0.0, 0.1, 0.2, 0.3, 0.4], atol=1e-6)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    p.name = "p0"
+    opt = paddle.optimizer.Adam(0.1, parameters=[p])
+    (p * 2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    p2 = paddle.to_tensor([1.0], stop_gradient=False)
+    p2.name = "p0"
+    opt2 = paddle.optimizer.Adam(0.1, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    st = opt2._states[id(p2)]
+    np.testing.assert_allclose(
+        np.asarray(st["moment1"]), np.asarray(opt._states[id(p)]["moment1"])
+    )
+
+
+def test_amp_autocast_bf16():
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        out = paddle.matmul(paddle.ones([2, 2]), paddle.ones([2, 2]))
+        assert out.dtype.name == "bfloat16"
+        # black-listed op stays fp32
+        s = paddle.nn.functional.softmax(out)
+        assert s.dtype.name == "float32"
+    out2 = paddle.matmul(paddle.ones([2, 2]), paddle.ones([2, 2]))
+    assert out2.dtype.name == "float32"
+
+
+def test_grad_scaler_skips_inf():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = paddle.optimizer.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    loss = (p * float("inf")).sum()
+    scaler.minimize(opt, scaler.scale(loss))
+    np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+    assert scaler._scale == 4.0  # decr after 2 bad steps (default)
+
+
+def test_master_weights_multi_precision():
+    p = paddle.Parameter(np.ones(4, np.float16))
+    opt = paddle.optimizer.Adam(0.1, parameters=[p], multi_precision=True)
+    (p.astype("float32") * 2).sum().backward()
+    assert p.grad is not None
+    opt.step()
+    st = opt._states[id(p)]
+    assert "master" in st and str(st["master"].dtype) == "float32"
+    assert p.dtype.name == "float16"

@@ -1,0 +1,115 @@
+"""vision models + hapi Model + metric tests (config #1 surface)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.metric import Accuracy, Precision, Recall
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet, resnet18
+from paddle_tpu.vision import transforms as T
+
+
+def test_resnet18_forward_shapes():
+    net = resnet18(num_classes=7)
+    out = net(paddle.randn([2, 3, 32, 32]))
+    assert out.shape == [2, 7]
+
+
+def test_resnet_train_step_decreases_loss():
+    paddle.seed(0)
+    net = resnet18(num_classes=4)
+    opt = paddle.optimizer.Momentum(0.01, parameters=net.parameters())
+    x = paddle.randn([4, 3, 32, 32])
+    y = paddle.randint(0, 4, [4])
+    losses = []
+    for _ in range(5):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_lenet_hapi_fit_improves():
+    paddle.seed(0)
+    train = FakeData(size=32, image_shape=(1, 28, 28), num_classes=4)
+    model = paddle.Model(LeNet(num_classes=4))
+    model.prepare(
+        paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    r0 = model.evaluate(train, batch_size=16, verbose=0)
+    model.fit(train, epochs=3, batch_size=16, verbose=0)
+    r1 = model.evaluate(train, batch_size=16, verbose=0)
+    assert r1["loss"] < r0["loss"]
+
+
+def test_model_save_load(tmp_path):
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    p = str(tmp_path / "ck")
+    model.save(p)
+    w_before = model.network.features[0].weight.numpy().copy()
+    model.network.features[0].weight.set_value(np.zeros_like(w_before))
+    model.load(p)
+    np.testing.assert_allclose(
+        model.network.features[0].weight.numpy(), w_before
+    )
+
+
+def test_accuracy_metric():
+    acc = Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(
+        [[0.1, 0.9, 0.0], [0.8, 0.1, 0.1], [0.1, 0.2, 0.7]]
+    )
+    label = paddle.to_tensor([1, 2, 2])
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    top1, top2 = acc.accumulate()
+    np.testing.assert_allclose(top1, 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(top2, 2 / 3, rtol=1e-6)
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.1, 0.8, 0.2])
+    labels = np.array([1, 0, 0, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == 0.5  # tp=1 fp=1
+    assert r.accumulate() == 0.5  # tp=1 fn=1
+
+
+def test_transforms_pipeline():
+    tf = T.Compose([
+        T.Resize(16), T.CenterCrop(12), T.ToTensor(),
+        T.Normalize([0.5] * 3, [0.5] * 3),
+    ])
+    img = np.random.randint(0, 255, (20, 24, 3), np.uint8)
+    out = tf(img)
+    assert out.shape == [3, 12, 12]
+    assert out.dtype.name == "float32"
+
+
+def test_random_transforms_shapes():
+    img = np.random.randint(0, 255, (32, 32, 3), np.uint8)
+    assert T.RandomCrop(24)(img).shape == (24, 24, 3)
+    assert T.RandomHorizontalFlip(1.0)(img).shape == (32, 32, 3)
+    np.testing.assert_array_equal(
+        T.RandomHorizontalFlip(1.0)(img), img[:, ::-1]
+    )
+
+
+def test_early_stopping():
+    train = FakeData(size=16, image_shape=(1, 28, 28), num_classes=4)
+    model = paddle.Model(LeNet(num_classes=4))
+    model.prepare(
+        paddle.optimizer.SGD(0.0, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+    )
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0, mode="min")
+    model.fit(train, eval_data=train, epochs=5, batch_size=8, verbose=0,
+              callbacks=[es])
+    assert model.stop_training
