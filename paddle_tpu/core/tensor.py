@@ -39,8 +39,13 @@ def _coerce_value(data, dtype=None, place=None):
             jdt = to_jax_dtype(get_default_dtype())
         else:
             jdt = arr.dtype
-    device = device_for_place(place)
-    return jax.device_put(arr.astype(jdt, copy=False), device)
+    arr = arr.astype(jdt, copy=False)
+    if place is not None:
+        # explicit placement commits the buffer to that device
+        return jax.device_put(arr, device_for_place(place))
+    # uncommitted: follows the computation (composes with mesh-sharded
+    # operands instead of pinning to device 0)
+    return jnp.asarray(arr)
 
 
 class Tensor:
@@ -267,6 +272,16 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {v.shape} vs {self._value.shape}"
             )
+        # keep the existing distribution (a sharded param stays sharded)
+        old_sharding = getattr(self._value, "sharding", None)
+        if old_sharding is not None and getattr(v, "sharding", None) != old_sharding:
+            try:
+                v = jax.device_put(v, old_sharding)
+            except Exception as e:
+                raise ValueError(
+                    f"set_value could not restore the tensor's sharding "
+                    f"{old_sharding}: {e}"
+                ) from e
         self._value = v
         return self
 

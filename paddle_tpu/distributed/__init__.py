@@ -1,0 +1,209 @@
+"""paddle.distributed — TPU-native distributed stack.
+
+Design (SURVEY.md §2.3 TPU mapping): there is no host-driven NCCL backend.
+``init_parallel_env`` ≈ ``jax.distributed.initialize`` (PJRT coordination
+replaces TCPStore rendezvous); parallelism is expressed as ONE SPMD
+program over a named ``jax.sharding.Mesh`` and XLA lowers the collectives
+onto ICI/DCN. The eager collective API below is kept for fleet-API
+compatibility: in the single-controller world a Tensor is already global,
+so cross-"rank" reductions are identities on replicated data and
+mesh-axis reductions on sharded data.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from .communication.group import Group, new_group, get_group, is_initialized  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "all_reduce", "all_gather", "all_gather_object", "broadcast", "reduce",
+    "scatter", "barrier", "all_to_all", "send", "recv", "ReduceOp",
+    "new_group", "get_group", "is_initialized", "spawn", "launch",
+    "get_backend", "DataParallel", "fleet", "split", "shard_tensor",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class ParallelEnv:
+    """Env describing this controller process (reference: ParallelEnv)."""
+
+    def __init__(self):
+        self._initialized = False
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def world_size(self):
+        # paddle semantics: number of trainers. In multi-controller runs
+        # that is the process count; device parallelism is mesh-level.
+        return jax.process_count()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+parallel_env = ParallelEnv()
+
+
+def init_parallel_env():
+    """Bootstrap multi-controller JAX if launch env vars are present.
+
+    Single-process runs (the common TPU pattern: one controller, many
+    chips) need no rendezvous at all — the mesh covers all devices.
+    """
+    if parallel_env._initialized:
+        return parallel_env
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n > 1 and jax.process_count() == 1:
+        coordinator = os.environ.get("PADDLE_MASTER") or os.environ.get(
+            "MASTER_ADDR", "127.0.0.1:8701"
+        )
+        pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=n, process_id=pid
+        )
+    parallel_env._initialized = True
+    return parallel_env
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def get_backend():
+    return "xla"
+
+
+# -- eager collectives -------------------------------------------------------
+def _ensure_tensor(t):
+    return t if isinstance(t, Tensor) else Tensor(t)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """On replicated/global data this is the identity (the value already
+    includes every shard's contribution under GSPMD); kept for API parity."""
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    # materialize all pending work (the closest eager analog)
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    n = get_world_size(group)
+    t = _ensure_tensor(tensor)
+    if isinstance(tensor_list, list):
+        del tensor_list[:]
+        tensor_list.extend(Tensor(t._value) for _ in range(max(n, 1)))
+        return tensor_list
+    return [Tensor(t._value) for _ in range(max(n, 1))]
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = max(get_world_size(group), 1)
+    del object_list[:]
+    object_list.extend(obj for _ in range(n))
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(tensor_list[get_rank(group)])
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    del out_tensor_list[:]
+    out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point eager send/recv has no single-controller analog; "
+        "pipeline parallelism uses per-stage device placement instead"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point eager send/recv has no single-controller analog; "
+        "pipeline parallelism uses per-stage device placement instead"
+    )
+
+
+def split(x, num_or_sections, axis=0):
+    from ..tensor.manipulation import split as _split
+
+    return _split(x, num_or_sections, axis)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """The reference forks one process per GPU; on TPU the SPMD program
+    already spans every chip, so spawn degenerates to a direct call."""
+    func(*args)
+
+
+def launch():
+    from .launch.main import main
+
+    main()
+
+
+# -- submodules --------------------------------------------------------------
+from . import fleet  # noqa: E402,F401
+from .parallel import DataParallel  # noqa: E402
+from . import utils  # noqa: E402,F401
+from .auto_parallel.api import shard_tensor  # noqa: E402
+from . import auto_parallel  # noqa: E402,F401
+from . import checkpoint  # noqa: E402,F401
+from . import sharding  # noqa: E402,F401

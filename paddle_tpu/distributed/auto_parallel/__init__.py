@@ -1,0 +1,7 @@
+"""paddle.distributed.auto_parallel (reference:
+python/paddle/distributed/auto_parallel/ — unverified, SURVEY.md §0)."""
+from .process_mesh import ProcessMesh  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, dtensor_from_fn, reshard, shard_layer,
+    Shard, Replicate, Partial,
+)

@@ -1,0 +1,93 @@
+"""Auto-parallel tensor API (reference:
+python/paddle/distributed/auto_parallel/api.py — unverified, SURVEY.md
+§0). ``shard_tensor``'s (ProcessMesh, placements) IS GSPMD's
+(Mesh, PartitionSpec); Shard/Replicate/Partial placements map directly.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "Shard", "Replicate", "Partial",
+]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return "Partial()"
+
+
+def _sharding_from_placements(mesh: ProcessMesh, placements, ndim):
+    """placements[i] describes mesh dim i → build the PartitionSpec."""
+    jmesh = mesh.to_jax_mesh()
+    entries: list = [None] * ndim
+    for mesh_dim, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            d = placement.dim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return NamedSharding(jmesh, PartitionSpec(*entries))
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """paddle.distributed.shard_tensor → Tensor whose value carries the
+    NamedSharding (a DistTensor in reference terms)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    sharding = _sharding_from_placements(mesh, placements, t.ndim)
+    new_val = jax.device_put(t._value, sharding)
+    if isinstance(data, Tensor):
+        data._value = new_val
+        data.process_mesh = mesh
+        data.placements = list(placements)
+        return data
+    out = Tensor(new_val, stop_gradient=True if stop_gradient is None else stop_gradient)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Apply shard_fn(name, layer, mesh) over sublayers (reference API)."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer

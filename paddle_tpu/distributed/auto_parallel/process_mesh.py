@@ -1,0 +1,74 @@
+"""ProcessMesh (reference:
+python/paddle/distributed/auto_parallel/process_mesh.py — unverified,
+SURVEY.md §0). Maps 1:1 onto jax.sharding.Mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._shape = tuple(arr.shape)
+        self._process_ids = [int(i) for i in arr.reshape(-1)]
+        self._dim_names = (
+            list(dim_names)
+            if dim_names is not None
+            else [f"d{i}" for i in range(arr.ndim)]
+        )
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    processes = process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def to_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            grid = np.asarray(
+                [devices[i % len(devices)] for i in self._process_ids]
+            ).reshape(self._shape)
+            self._jax_mesh = Mesh(grid, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+        )
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._process_ids)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"

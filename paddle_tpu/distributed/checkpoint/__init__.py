@@ -1,0 +1,6 @@
+"""paddle.distributed.checkpoint — sharded save/load with reshard-on-load
+(reference: python/paddle/distributed/checkpoint/ — unverified, SURVEY.md
+§0). Each host writes its local shards + a metadata json; load reassembles
+and reshards to the current mesh.
+"""
+from .save_load import save_state_dict, load_state_dict  # noqa: F401

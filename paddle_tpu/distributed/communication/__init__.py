@@ -1,0 +1,1 @@
+from .group import Group, new_group, get_group, is_initialized  # noqa: F401

@@ -1,0 +1,66 @@
+"""Communication groups (reference:
+python/paddle/distributed/communication/group.py — unverified, SURVEY.md
+§0). A Group is a logical handle naming a mesh axis (or a rank subset);
+collectives over a group compile to XLA collectives over that axis.
+"""
+from __future__ import annotations
+
+__all__ = ["Group", "new_group", "get_group", "is_initialized"]
+
+_GROUP_COUNTER = [0]
+_GROUPS: dict[int, "Group"] = {}
+
+
+class Group:
+    def __init__(self, rank, ranks, id=0, mesh_axis=None, name=None):
+        self.rank = rank  # this process's rank inside the group
+        self.ranks = list(ranks)
+        self.id = id
+        self.mesh_axis = mesh_axis  # mesh axis this group rides, if any
+        self._name = name or f"group_{id}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def name(self):
+        return self._name
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return self.rank >= 0
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.mesh_axis})"
+
+
+def new_group(ranks=None, backend=None, timeout=None, mesh_axis=None):
+    from .. import get_rank, get_world_size
+
+    _GROUP_COUNTER[0] += 1
+    gid = _GROUP_COUNTER[0]
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    me = get_rank()
+    grp = Group(
+        ranks.index(me) if me in ranks else -1, ranks, gid, mesh_axis
+    )
+    _GROUPS[gid] = grp
+    return grp
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid)
+
+
+def is_initialized():
+    from .. import parallel_env
+
+    return parallel_env._initialized

@@ -1,0 +1,119 @@
+"""paddle.distributed.fleet (reference: python/paddle/distributed/fleet/
+— unverified, SURVEY.md §0).
+
+``fleet.init(is_collective=True, strategy)`` builds the hybrid topology →
+one jax Mesh (+ per-stage sub-meshes for pp); ``distributed_model`` wraps
+the Layer per the active degrees; ``distributed_optimizer`` returns the
+optimizer (sharding applied via group_sharded / strategy.sharding).
+"""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    CommunicateTopology, HybridCommunicateGroup, get_hybrid_communicate_group,
+)
+from .meta_parallel.meta_parallel_base import TensorParallel
+from .meta_parallel.pipeline_parallel import (
+    PipelineParallel, PipelineParallelWithInterleave,
+)
+from .meta_parallel.parallel_layers.pp_layers import (
+    LayerDesc, SharedLayerDesc, PipelineLayer,
+)
+from . import utils  # noqa: F401
+from .utils.recompute import recompute  # noqa: F401
+from ..communication.group import new_group  # noqa: F401
+
+__all__ = [
+    "init", "fleet", "DistributedStrategy", "HybridCommunicateGroup",
+    "CommunicateTopology", "get_hybrid_communicate_group",
+    "distributed_model", "distributed_optimizer", "PipelineLayer",
+    "LayerDesc", "SharedLayerDesc", "PipelineParallel", "TensorParallel",
+    "worker_num", "worker_index", "recompute",
+]
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    strategy = strategy or DistributedStrategy()
+    from .. import init_parallel_env
+
+    init_parallel_env()
+    hcg = HybridCommunicateGroup(strategy)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_parallel_strategy():
+    return _fleet_state["strategy"]
+
+
+def _hcg():
+    return _fleet_state["hcg"]
+
+
+def distributed_model(model):
+    strategy = _fleet_state["strategy"] or DistributedStrategy()
+    hcg = _fleet_state["hcg"]
+    hc = strategy.hybrid_configs
+    if int(hc["pp_degree"]) > 1:
+        return PipelineParallel(model, hcg, strategy)
+    if int(hc["mp_degree"]) > 1:
+        return TensorParallel(model, hcg, strategy)
+    from ..parallel import DataParallel
+
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+    if strategy.sharding:
+        stage = int(strategy.sharding_configs.get("stage", 1))
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}[stage]
+        from .meta_parallel.sharding.group_sharded import (
+            _patch_optimizer_state_sharding,
+        )
+
+        optimizer = _patch_optimizer_state_sharding(optimizer)
+    return optimizer
+
+
+def worker_num():
+    from .. import get_world_size
+
+    return get_world_size()
+
+
+def worker_index():
+    from .. import get_rank
+
+    return get_rank()
+
+
+def barrier_worker():
+    from .. import barrier
+
+    barrier()
+
+
+class _FleetFacade:
+    """`from paddle.distributed import fleet; fleet.init(...)` object-style
+    access used by some reference code paths."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    DistributedStrategy = DistributedStrategy
+    worker_num = staticmethod(worker_num)
+    worker_index = staticmethod(worker_index)
+
+
+fleet = _FleetFacade()

@@ -1,0 +1,204 @@
+"""Hybrid topology (reference:
+python/paddle/distributed/fleet/base/topology.py — unverified, SURVEY.md
+§0). ``HybridCommunicateGroup`` builds the reference's N-D rank topology;
+here it also materializes the jax Mesh: non-pp axes form ONE global mesh
+(axes ``dp``, ``sharding``, ``sep``, ``mp``) and the pp axis becomes a
+list of per-stage sub-meshes (pipeline stages own disjoint device sets,
+exactly like the reference's pp communication groups).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ....parallel import mesh as mesh_state
+from ..base.distributed_strategy import DistributedStrategy
+from ...communication.group import Group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_HCG = None
+
+
+def _set_hcg(hcg):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group():
+    return _HCG
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims))
+        coords = np.arange(self._world_size).reshape(self._dims)
+        self._coords = coords
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._coords[coord])
+
+    def get_coord(self, rank):
+        idx = np.argwhere(self._coords == rank)[0]
+        return dict(zip(self._parallel_names, (int(i) for i in idx)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return [int(r) for r in self._coords[tuple(sl)].reshape(-1)]
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._coords, axis, -1).reshape(-1, self._dims[axis])
+        return [list(map(int, row)) for row in moved]
+
+
+class HybridCommunicateGroup:
+    def __init__(self, strategy: DistributedStrategy | None = None,
+                 topology: CommunicateTopology | None = None):
+        strategy = strategy or DistributedStrategy()
+        hc = strategy.hybrid_configs
+        self._dp_degree = int(hc["dp_degree"])
+        self._mp_degree = int(hc["mp_degree"])
+        self._pp_degree = int(hc["pp_degree"])
+        self._sharding_degree = int(hc["sharding_degree"])
+        self._sep_degree = int(hc.get("sep_degree", 1))
+
+        devices = jax.devices()
+        n_dev = len(devices)
+        need = (
+            self._dp_degree * self._mp_degree * self._pp_degree
+            * self._sharding_degree * self._sep_degree
+        )
+        if need > n_dev:
+            raise ValueError(
+                f"hybrid degrees need {need} devices but only {n_dev} present"
+            )
+        # auto-expand dp to soak up remaining devices (paddle requires the
+        # product to equal world size; dp is the flexible axis)
+        if need < n_dev:
+            if n_dev % need != 0:
+                raise ValueError(
+                    f"hybrid degrees product {need} does not divide the "
+                    f"device count {n_dev}; adjust the degrees"
+                )
+            self._dp_degree *= n_dev // need
+
+        self._topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"),
+            (self._dp_degree, self._pp_degree, self._sharding_degree,
+             self._sep_degree, self._mp_degree),
+        )
+
+        # device grid: (pp, dp, sharding, sep, mp)
+        grid = np.array(devices).reshape(
+            self._pp_degree, self._dp_degree, self._sharding_degree,
+            self._sep_degree, self._mp_degree,
+        )
+        self._stage_meshes = []
+        for s in range(self._pp_degree):
+            self._stage_meshes.append(
+                Mesh(grid[s], ("dp", "sharding", "sep", "mp"))
+            )
+        # the global (stage-0) mesh drives non-pp sharding
+        mesh_state.set_mesh(self._stage_meshes[0])
+        _set_hcg(self)
+
+        # single-controller: this process sees the whole program. Rank
+        # semantics (get_parallel_rank) follow the process index for
+        # multi-host launches and 0 otherwise.
+        self.global_rank = jax.process_index()
+
+    # -- mesh access ---------------------------------------------------------
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_stage_mesh(self, stage: int) -> Mesh:
+        return self._stage_meshes[stage]
+
+    @property
+    def num_stages(self):
+        return self._pp_degree
+
+    # -- degree accessors (reference API) ------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def _make_group(self, axis, degree):
+        return Group(0, list(range(degree)), mesh_axis=axis)
+
+    def get_data_parallel_group(self):
+        return self._make_group("dp", self._dp_degree)
+
+    def get_model_parallel_group(self):
+        return self._make_group("mp", self._mp_degree)
+
+    def get_pipe_parallel_group(self):
+        return self._make_group(None, self._pp_degree)
+
+    def get_sharding_parallel_group(self):
+        return self._make_group("sharding", self._sharding_degree)
+
+    def get_sep_parallel_group(self):
+        return self._make_group("sep", self._sep_degree)
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._make_group(None, 1)
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    # pipeline helpers used by PipelineParallel
+    def is_first_stage(self):
+        return True
+
+    def is_last_stage(self):
+        return True

@@ -1,0 +1,6 @@
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from . import mp_ops  # noqa: F401
+from .....core.random import get_rng_state_tracker  # noqa: F401

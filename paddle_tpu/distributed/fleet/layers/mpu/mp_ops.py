@@ -1,0 +1,40 @@
+"""mp ops facade (reference: .../layers/mpu/mp_ops.py — unverified).
+
+``_c_identity``/``_mp_allreduce`` were ProcessGroupNCCL calls in the
+reference; under GSPMD they reduce to sharding constraints/identities."""
+from __future__ import annotations
+
+from .....parallel import mesh as mesh_state
+from .....tensor._helpers import apply, ensure_tensor
+
+__all__ = ["_c_identity", "_mp_allreduce", "_c_concat", "_c_split"]
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    return ensure_tensor(tensor)
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True, use_model_parallel=True):
+    t = ensure_tensor(tensor)
+    return apply(
+        lambda v: mesh_state.constraint(v, *([None] * v.ndim)),
+        t, op_name="mp_allreduce",
+    )
+
+
+def _c_concat(tensor, group=None):
+    t = ensure_tensor(tensor)
+    return apply(
+        lambda v: mesh_state.constraint(v, *([None] * v.ndim)),
+        t, op_name="c_concat",
+    )
+
+
+def _c_split(tensor, group=None):
+    t = ensure_tensor(tensor)
+
+    def fn(v):
+        spec = [None] * (v.ndim - 1) + ["mp"]
+        return mesh_state.constraint(v, *spec)
+
+    return apply(fn, t, op_name="c_split")

@@ -1,0 +1,8 @@
+from .meta_parallel_base import MetaParallelBase, TensorParallel  # noqa: F401
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .parallel_layers.pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from . import sharding  # noqa: F401

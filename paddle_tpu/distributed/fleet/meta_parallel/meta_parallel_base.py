@@ -1,0 +1,48 @@
+"""Meta-parallel base + TensorParallel wrapper (reference:
+.../meta_parallel/meta_parallel_base.py, tensor_parallel.py)."""
+from __future__ import annotations
+
+__all__ = ["MetaParallelBase", "TensorParallel", "_get_hcg"]
+
+
+def _get_hcg():
+    from ..base.topology import get_hybrid_communicate_group
+
+    return get_hybrid_communicate_group()
+
+
+class MetaParallelBase:
+    def __init__(self, layers, hcg, strategy):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class TensorParallel(MetaParallelBase):
+    """TP wrapper: the mp layers already carry their shardings; under
+    GSPMD no broadcast/sync of the non-distributed params is needed (they
+    are replicated arrays in one program)."""
+    pass

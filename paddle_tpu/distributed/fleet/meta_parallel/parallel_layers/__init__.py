@@ -1,0 +1,1 @@
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401

@@ -1,0 +1,154 @@
+"""Pipeline layer descriptions (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py
+— unverified, SURVEY.md §0).
+
+``PipelineLayer`` keeps the reference API (LayerDesc list → stage
+partition by layer count / regex seg_method). Single-controller twist:
+every stage is instantiated in this process and its params are placed on
+that stage's sub-mesh devices; the 1F1B loop moves activations between
+stage meshes (the reference's p2p send/recv becomes device_put over ICI).
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.common import LayerList
+from .....parallel.mesh import MeshScope
+from .....parallel import mesh as mesh_state
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, num_virtual_pipeline_stages=None,
+                 **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        from ..meta_parallel_base import _get_hcg
+
+        hcg = _get_hcg()
+        if num_stages is None:
+            num_stages = hcg.num_stages if hcg is not None else 1
+        self._num_stages = num_stages
+        self._descs = list(layers)
+
+        # build all layers (single controller owns every stage)
+        built = []
+        self._shared: dict[str, Layer] = {}
+        for desc in self._descs:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared:
+                    built.append((self._shared[desc.layer_name], desc))
+                else:
+                    layer = desc.build_layer()
+                    self._shared[desc.layer_name] = layer
+                    built.append((layer, desc))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), desc))
+            elif isinstance(desc, Layer):
+                built.append((desc, None))
+            elif callable(desc):
+                built.append((desc, None))
+            else:
+                raise TypeError(f"unsupported pipeline item {desc!r}")
+
+        # stage partition
+        self._segment = self._segment_layers(built, num_stages, seg_method)
+        self.run_function = LayerList(
+            [l for l, _ in built if isinstance(l, Layer)]
+        )
+        self._items = built
+
+        # place each stage's params on its stage mesh; a layer shared
+        # across stages (tied embeddings) is placed once, on its FIRST
+        # owning stage — later stages reach it through the inter-stage
+        # transfer, like the reference's shared-weight broadcast group
+        if hcg is not None and hcg.num_stages > 1:
+            placed: set[int] = set()
+            for stage, (lo, hi) in enumerate(self._segment):
+                mesh = hcg.get_stage_mesh(stage)
+                for item, _ in built[lo:hi]:
+                    if isinstance(item, Layer) and id(item) not in placed:
+                        placed.add(id(item))
+                        with MeshScope(mesh):
+                            for _, p in item.named_parameters():
+                                p._value = mesh_state.replicate_value(p._value)
+
+    def _segment_layers(self, built, num_stages, seg_method):
+        n = len(built)
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            pat = seg_method.split("layer:")[1]
+            marks = [
+                i for i, (l, _) in enumerate(built)
+                if re.search(pat, type(l).__name__)
+            ]
+            if len(marks) >= num_stages:
+                per = len(marks) // num_stages
+                bounds = [0]
+                for s in range(1, num_stages):
+                    bounds.append(marks[s * per])
+                bounds.append(n)
+                return [(bounds[i], bounds[i + 1]) for i in range(num_stages)]
+        # uniform
+        sizes = [n // num_stages] * num_stages
+        for i in range(n % num_stages):
+            sizes[i] += 1
+        out, off = [], 0
+        for s in sizes:
+            out.append((off, off + s))
+            off += s
+        return out
+
+    def get_stage_items(self, stage):
+        lo, hi = self._segment[stage]
+        return [l for l, _ in self._items[lo:hi]]
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    @property
+    def loss_fn(self):
+        return self._loss_fn
+
+    def forward_stage(self, x, stage):
+        from ..pp_utils.utils import run_items
+
+        return run_items(self.get_stage_items(stage), x,
+                         self._recompute_interval)
+
+    def forward(self, *args):
+        x = args if len(args) > 1 else args[0]
+        from ..pp_utils.utils import run_items
+
+        return run_items([l for l, _ in self._items], x,
+                         self._recompute_interval)

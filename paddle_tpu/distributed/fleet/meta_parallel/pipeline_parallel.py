@@ -1,0 +1,195 @@
+"""PipelineParallel — host-driven 1F1B (reference:
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py —
+unverified, SURVEY.md §0).
+
+The reference runs one process per stage exchanging tensors with NCCL
+p2p; here one controller drives every stage's devices. The 1F1B schedule
+is preserved: warmup forwards fill the pipeline, then forward/backward
+alternate, then cooldown backwards drain it. Because dispatch is async,
+stage k's compute for microbatch i overlaps stage k-1's for microbatch
+i+1 on different devices — the same overlap the reference gets from
+separate processes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....core.tensor import Tensor
+from .parallel_layers.pp_layers import PipelineLayer
+from .pp_utils.utils import transfer_to_mesh
+from ....parallel.mesh import MeshScope
+
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
+
+
+class PipelineParallel:
+    def __init__(self, layers, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = strategy.pipeline_configs
+        self._acc_steps = int(pp_cfg.get("accumulate_steps", 1))
+        self._micro_batch_size = int(pp_cfg.get("micro_batch_size", 1))
+        self.num_stages = hcg.num_stages if hcg is not None else layers.num_stages
+
+    # expose the wrapped layer API
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def _split_micro_batches(self, data):
+        """data: (inputs, labels) paddle-style → list of micro (x, y)."""
+        x, y = data
+        x = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+        y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+        m = self._acc_steps
+        bs = x.shape[0]
+        if bs % m != 0:
+            raise ValueError(f"batch {bs} not divisible by accumulate_steps {m}")
+        mb = bs // m
+        micros = []
+        for i in range(m):
+            micros.append((x[i * mb : (i + 1) * mb], y[i * mb : (i + 1) * mb]))
+        return micros
+
+    def _forward_micro(self, x):
+        """Forward one microbatch through all stages w/ inter-stage moves."""
+        out = x
+        multi = self.num_stages > 1 and self._hcg is not None
+        for s in range(self.num_stages):
+            if multi:
+                mesh = self._hcg.get_stage_mesh(s)
+                out = transfer_to_mesh(out, mesh)
+                with MeshScope(mesh):
+                    out = self._layers.forward_stage(out, s)
+            else:
+                out = self._layers.forward_stage(out, s)
+        return out
+
+    def _compute_loss(self, out, label):
+        loss_fn = self._layers.loss_fn
+        if loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        return loss_fn(out, label)
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Run the 1F1B schedule; returns the MEAN microbatch loss."""
+        micros = self._split_micro_batches(data)
+        m = len(micros)
+        num_warmup = min(self.num_stages, m)
+        pending = []  # scaled losses awaiting backward (1F1B window)
+        all_losses = []
+
+        def fwd(i):
+            x, y = micros[i]
+            out = self._forward_micro(x)
+            loss = self._compute_loss(out, y)
+            all_losses.append(loss)
+            scaled = loss / m
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            return scaled
+
+        fwd_i = 0
+        for _ in range(num_warmup):  # warmup forwards fill the pipeline
+            pending.append(fwd(fwd_i))
+            fwd_i += 1
+        while fwd_i < m:  # steady state: one backward per forward
+            pending.pop(0).backward()
+            pending.append(fwd(fwd_i))
+            fwd_i += 1
+        while pending:  # cooldown backwards drain it
+            pending.pop(0).backward()
+        return float(
+            sum(float(l.numpy()) for l in all_losses) / max(m, 1)
+        )
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        self._layers.train()
+        micros = self._split_micro_batches(data)
+        m = len(micros)
+        losses = []
+        num_warmup = min(self.num_stages, m)
+        pending = []
+
+        def fwd(i):
+            x, y = micros[i]
+            out = self._forward_micro(x)
+            loss = self._compute_loss(out, y)
+            losses.append(loss)
+            scaled = loss / m
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            return scaled
+
+        fwd_i = 0
+        for _ in range(num_warmup):
+            pending.append(fwd(fwd_i))
+            fwd_i += 1
+        while fwd_i < m:
+            pending.pop(0).backward()
+            pending.append(fwd(fwd_i))
+            fwd_i += 1
+        while pending:
+            pending.pop(0).backward()
+
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from ....tensor.manipulation import stack
+        from ....tensor.math import mean
+
+        return mean(stack([l.detach() for l in losses]))
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        from ....core import autograd
+
+        with autograd.no_grad():
+            micros = self._split_micro_batches(data)
+            losses = []
+            for x, y in micros:
+                out = self._forward_micro(x)
+                if compute_loss:
+                    losses.append(self._compute_loss(out, y))
+                else:
+                    losses.append(out)
+            if compute_loss:
+                from ....tensor.manipulation import stack
+                from ....tensor.math import mean
+
+                return mean(stack(losses))
+            return losses
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved (virtual-stage) schedule. With a single controller the
+    device-overlap benefit of virtual stages is already captured by async
+    dispatch; the schedule reduces to 1F1B over the finer stage list."""
+    pass

@@ -1,0 +1,47 @@
+"""Pipeline helpers (reference: .../meta_parallel/pp_utils/utils.py)."""
+from __future__ import annotations
+
+from .....core.tensor import Tensor
+
+__all__ = ["run_items", "transfer_to_mesh"]
+
+
+def run_items(items, x, recompute_interval=0):
+    """Run a slice of pipeline items; tuple outputs thread through."""
+    from ...utils.recompute import recompute
+    from .....nn.layer.layers import Layer
+
+    i = 0
+    n = len(items)
+    while i < n:
+        item = items[i]
+        use_rc = (
+            recompute_interval > 0
+            and isinstance(item, Layer)
+            and i % recompute_interval == 0
+        )
+        if isinstance(x, tuple):
+            x = recompute(item, *x) if use_rc else item(*x)
+        else:
+            x = recompute(item, x) if use_rc else item(x)
+        i += 1
+    return x
+
+
+def transfer_to_mesh(x, mesh):
+    """Move activation(s) onto a stage sub-mesh (the p2p send/recv
+    analog: a device_put over ICI between disjoint device sets)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .....core.dispatch import apply
+
+    def move(t):
+        sharding = NamedSharding(mesh, PartitionSpec())
+        return apply(
+            lambda v: jax.device_put(v, sharding), t, op_name="pp_transfer"
+        )
+
+    if isinstance(x, tuple):
+        return tuple(move(t) if isinstance(t, Tensor) else t for t in x)
+    return move(x)

@@ -1,0 +1,145 @@
+"""Group sharding — ZeRO stages 1/2/3 (reference:
+python/paddle/distributed/fleet/meta_parallel/sharding/group_sharded_*.py
+and python/paddle/distributed/sharding/group_sharded.py — unverified,
+SURVEY.md §0).
+
+TPU-native mechanics: "sharding" is a NamedSharding over the ``sharding``
+mesh axis, not graph surgery —
+
+- stage 1 (``os``): optimizer accumulators sharded (dim-0) over the axis;
+  params/grads replicated.
+- stage 2 (``os_g``): same placements; GSPMD already reduce-scatters the
+  grad contributions that feed sharded accumulators, which is the
+  reference's grad-shard hook.
+- stage 3 (``p_g_os``): param values themselves sharded dim-0; XLA
+  all-gathers them where the forward needs them and reshards after — the
+  reference's pre-fetch/post-free hooks, compiled.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....parallel import mesh as mesh_state
+
+__all__ = [
+    "group_sharded_parallel", "save_group_sharded_model",
+    "GroupShardedStage2", "GroupShardedStage3", "GroupShardedOptimizerStage2",
+]
+
+
+def _shard_dim0(value):
+    return mesh_state.shard_value(value, "sharding")
+
+
+def _patch_optimizer_state_sharding(optimizer):
+    """Make new accumulators come out sharded on dim 0."""
+    orig_init = optimizer._init_state
+
+    def sharded_init(p_value):
+        st = orig_init(p_value)
+        return {k: _shard_dim0(v) for k, v in st.items()}
+
+    optimizer._init_state = sharded_init
+    # master weights are created in _state_for; shard those too
+    orig_state_for = optimizer._state_for
+
+    def state_for(param):
+        st = orig_state_for(param)
+        if "master" in st:
+            target = _shard_dim0(st["master"])
+            if getattr(st["master"], "sharding", None) != getattr(
+                target, "sharding", None
+            ):
+                st["master"] = target
+        return st
+
+    optimizer._state_for = state_for
+    return optimizer
+
+
+class _ShardedModelWrapper:
+    def __init__(self, layer):
+        self._layers = layer
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class GroupShardedStage2(_ShardedModelWrapper):
+    pass
+
+
+class GroupShardedStage3(_ShardedModelWrapper):
+    def __init__(self, layer, optimizer=None, group=None, sync_comm=False,
+                 segment_size=2**20, **kwargs):
+        super().__init__(layer)
+        for _, p in layer.named_parameters():
+            p._value = _shard_dim0(p._value)
+            p.is_sharded = True
+
+    def get_all_parameters(self):
+        """Gather full params (reference: stage3 all-gather for save)."""
+        for _, p in self._layers.named_parameters():
+            p._value = mesh_state.replicate_value(p._value)
+        return self._layers.parameters()
+
+
+class GroupShardedOptimizerStage2:
+    def __init__(self, params, optim, group=None, **kwargs):
+        self._optim = _patch_optimizer_state_sharding(optim)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_optim"], name)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """paddle.distributed.sharding.group_sharded_parallel."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level must be one of os | os_g | p_g_os")
+    if mesh_state.mesh_axis_size("sharding") <= 1 and mesh_state.get_mesh() is not None:
+        # allow running with dp axis as the sharding axis when only dp>1
+        pass
+    optimizer = _patch_optimizer_state_sharding(optimizer)
+    if level == "p_g_os":
+        model = GroupShardedStage3(model, optimizer)
+    else:
+        model = GroupShardedStage2(model)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+
+    from .....framework.io import save
+
+    if isinstance(model, GroupShardedStage3):
+        model.get_all_parameters()
+    target = model._layers if isinstance(model, _ShardedModelWrapper) else model
+    os.makedirs(output, exist_ok=True)
+    save(target.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

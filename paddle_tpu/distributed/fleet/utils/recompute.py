@@ -1,0 +1,106 @@
+"""Recompute / gradient checkpointing (reference:
+python/paddle/distributed/fleet/recompute/recompute.py — unverified,
+SURVEY.md §0). TPU-native: ``jax.checkpoint`` (remat) on the functional
+form of the wrapped Layer — XLA rematerializes activations in backward,
+trading FLOPs for HBM exactly like the reference's RecomputeFunction.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+from ....core import autograd
+from ....core.dispatch import apply
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute(layer_or_fn, *inputs)."""
+    from ....nn.layer.layers import Layer
+    from ....jit import functional_call
+
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    layer = None
+    fn = function
+    if isinstance(function, Layer):
+        layer = function
+        fn = function.forward
+    elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+        layer = function.__self__
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    params = [p for _, p in layer.named_parameters()] if layer else []
+    buffers = [b for _, b in layer.named_buffers()] if layer else []
+    n_args = len(tensor_args)
+    n_params = len(params)
+
+    from ....core.random import next_key, traced_key_scope
+
+    rng = next_key()
+
+    n_out = [None]  # number of real outputs (set at trace time)
+
+    @jax.checkpoint
+    def raw(*vals):
+        a_vals = list(vals[:n_args])
+        p_vals = list(vals[n_args : n_args + n_params])
+        b_vals = list(vals[n_args + n_params :])
+        rebuilt = []
+        ti = 0
+        oi = dict(other)
+        for i in range(len(args)):
+            if i in oi:
+                rebuilt.append(oi[i])
+            else:
+                rebuilt.append(Tensor(a_vals[ti], stop_gradient=True))
+                ti += 1
+        with autograd.no_grad(), traced_key_scope(rng):
+            if layer is not None:
+                out, new_buf = functional_call(
+                    layer, fn, rebuilt, kwargs, p_vals, b_vals
+                )
+            else:
+                out = fn(*rebuilt, **kwargs)
+                new_buf = []
+        flat = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda t: isinstance(t, Tensor)
+        )
+        n_out[0] = len(flat)
+        return tuple(
+            t._value if isinstance(t, Tensor) else t for t in flat
+        ) + tuple(new_buf)
+
+    results = apply(
+        raw, *tensor_args, *params, *[Tensor(b._value) for b in buffers],
+        op_name="recompute",
+    )
+    results = results if isinstance(results, tuple) else (results,)
+    outs = results[: n_out[0]]
+    new_bufs = results[n_out[0] :]
+    for b, nb in zip(buffers, new_bufs):
+        b._value = nb._value  # write back buffer mutations (BN stats)
+    if len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """recompute_sequential({'segments': k}, nn.Sequential(...), x)."""
+    segments = (ctx or {}).get("segments", 1)
+    layers = list(functions)
+    n = len(layers)
+    seg = max(n // max(segments, 1), 1)
+    out = args[0]
+    i = 0
+    from ....nn.layer.common import Sequential
+
+    while i < n:
+        chunk = layers[i : i + seg]
+        block = Sequential(*chunk)
+        out = recompute(block, out, **kwargs)
+        i += seg
+    return out

@@ -1,0 +1,123 @@
+"""Megatron-style sequence parallelism (reference:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+unverified, SURVEY.md §0).
+
+The reference all-gathers activations entering a parallel linear and
+reduce-scatters on exit so LayerNorm/dropout run sequence-sharded; under
+GSPMD the same schedule falls out of constraining the sequence dim to the
+``mp`` axis around the matmuls — XLA overlaps the ag/rs automatically.
+Layout convention matches the reference: (seq, batch, hidden) with the
+sequence dim sharded.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....parallel import mesh as mesh_state
+from ....tensor._helpers import apply, ensure_tensor
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _seq_shard(v):
+    spec = ["mp"] + [None] * (v.ndim - 1)
+    return mesh_state.constraint(v, *spec)
+
+
+def _seq_full(v):
+    return mesh_state.constraint(v, *([None] * v.ndim))
+
+
+class ScatterOp:
+    """Split along the sequence dim across mp (forward scatter)."""
+
+    @staticmethod
+    def apply(input):
+        return apply(_seq_shard, ensure_tensor(input), op_name="sp_scatter")
+
+
+class GatherOp:
+    @staticmethod
+    def apply(input):
+        return apply(_seq_full, ensure_tensor(input), op_name="sp_gather")
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(input):
+        return apply(_seq_full, ensure_tensor(input), op_name="sp_all_gather")
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(input):
+        return apply(_seq_shard, ensure_tensor(input), op_name="sp_reduce_scatter")
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Grad sync of sequence-parallel params is automatic under GSPMD
+    (grads of replicated params are reduced by the partitioner)."""
+    return
+
+
+class ColumnSequenceParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.is_distributed = True
+        self.weight._value = mesh_state.shard_value(self.weight._value, None, "mp")
+        self.bias = (
+            self.create_parameter((out_features,), is_bias=True)
+            if has_bias
+            else None
+        )
+
+    def forward(self, x):
+        # entry: gather sequence (mp) → full activations for the matmul
+        x = AllGatherOp.apply(x)
+        out = F.linear(x, self.weight, self.bias)
+
+        def mark(v):
+            spec = [None] * (v.ndim - 1) + ["mp"]
+            return mesh_state.constraint(v, *spec)
+
+        return apply(mark, out, op_name="col_sp_out")
+
+
+class RowSequenceParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.is_distributed = True
+        self.weight._value = mesh_state.shard_value(self.weight._value, "mp", None)
+        self.bias = (
+            self.create_parameter((out_features,), is_bias=True)
+            if has_bias
+            else None
+        )
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        # exit: reduce-scatter along sequence
+        return ReduceScatterOp.apply(out)

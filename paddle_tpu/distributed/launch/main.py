@@ -1,0 +1,67 @@
+"""python -m paddle_tpu.distributed.launch (reference:
+python/paddle/distributed/launch/main.py — unverified, SURVEY.md §0).
+
+The reference spawns one process per GPU; TPU-native launch runs ONE
+controller process per host — intra-host parallelism is the mesh. For
+multi-host ("nnodes"), it exports the coordinator env consumed by
+``init_parallel_env`` (jax.distributed.initialize) and execs the script.
+The PADDLE_* env contract is preserved so reference training scripts run
+unmodified.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["main"]
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--master", default=None,
+                        help="coordinator ip:port for multi-host jobs")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--rank", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINER_ID", 0)),
+                        help="node rank (process id)")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="accepted for compat; TPU runs 1 proc/host")
+    parser.add_argument("--devices", "--gpus", dest="devices", default=None,
+                        help="accepted for compat (mesh covers all chips)")
+    parser.add_argument("--job_id", default="default")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--run_mode", default="collective")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    env = dict(os.environ)
+    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    env.setdefault("PADDLE_LOCAL_RANK", "0")
+    env["PADDLE_JOB_ID"] = args.job_id
+
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        log_path = os.path.join(
+            args.log_dir, f"worker.{args.rank}.log"
+        )
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+            ret = proc.wait()
+    else:
+        proc = subprocess.Popen(cmd, env=env)
+        ret = proc.wait()
+    sys.exit(ret)
+
+
+if __name__ == "__main__":
+    main()

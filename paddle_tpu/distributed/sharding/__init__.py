@@ -1,0 +1,4 @@
+"""paddle.distributed.sharding (reference: python/paddle/distributed/sharding/)."""
+from ..fleet.meta_parallel.sharding.group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)

@@ -57,8 +57,7 @@ class Optimizer:
         self._multi_precision = multi_precision
         self._states: dict[int, dict] = {}
         self._step_count = 0
-        self._jitted = None
-        self._jit_shapes = None
+        self._jit_cache: dict = {}
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self) -> float:
@@ -159,26 +158,79 @@ class Optimizer:
         p_vals = [p._value for p in params]
         g_vals = [p.grad._value for p in params]
         s_vals = [self._state_for(p) for p in params]
-        decay_flags = tuple(self._decay_enabled(p) for p in params)
+        decay_flags = [self._decay_enabled(p) for p in params]
 
-        shapes = (tuple((v.shape, str(v.dtype)) for v in p_vals), decay_flags)
-        if self._jitted is None or self._jit_shapes != shapes:
-            def fused(ps, gs, ss, lr_, st_):
-                if self._grad_clip is not None:
-                    gs = self._grad_clip.clip_values(gs)
-                outs = [
-                    self._apply_one(p, g, s, lr_, st_, decay=d)
-                    for p, g, s, d in zip(ps, gs, ss, decay_flags)
-                ]
-                return [o[0] for o in outs], [o[1] for o in outs]
+        # Params may live on disjoint device sets (pipeline stages); a
+        # single XLA program cannot span them, so fuse per device set.
+        # Grad clipping with a GLOBAL norm must still see every grad, so
+        # the squared-norm is reduced across groups first.
+        def _devset(v):
+            try:
+                return tuple(sorted(d.id for d in v.sharding.device_set))
+            except Exception:
+                return ("default",)
 
-            self._jitted = jax.jit(fused)
-            self._jit_shapes = shapes
+        groups: dict = {}
+        for i, v in enumerate(p_vals):
+            groups.setdefault(_devset(v), []).append(i)
 
-        new_p, new_s = self._jitted(p_vals, g_vals, s_vals, lr, step_no)
-        for p, np_, ns in zip(params, new_p, new_s):
-            p._value = np_
-            self._states[id(p)] = ns
+        # Global-norm clipping across multiple device sets: reduce the
+        # squared norms per group, combine on host, feed the scale in as a
+        # traced scalar so in-group clipping is skipped.
+        from .clip import ClipGradByGlobalNorm
+
+        gscale = None
+        if isinstance(self._grad_clip, ClipGradByGlobalNorm) and len(groups) > 1:
+            import numpy as _np
+
+            # eager reductions (no jit: would retrace every step via the
+            # fresh closure; a handful of per-group reductions is cheap)
+            sq = 0.0
+            for devset, idxs in groups.items():
+                sq += float(
+                    sum(
+                        jnp.sum(jnp.square(g_vals[i].astype(jnp.float32)))
+                        for i in idxs
+                    )
+                )
+            global_norm = float(_np.sqrt(sq))
+            clip_norm = self._grad_clip.clip_norm
+            gscale = jnp.asarray(
+                clip_norm / max(global_norm, clip_norm), jnp.float32
+            )
+
+        for devset, idxs in groups.items():
+            sub_decay = tuple(decay_flags[i] for i in idxs)
+            shapes = tuple((p_vals[i].shape, str(p_vals[i].dtype)) for i in idxs)
+            cache_key = (devset, shapes, sub_decay, gscale is not None)
+            if cache_key not in self._jit_cache:
+                def fused(ps, gs, ss, lr_, st_, gscale_, _decay=sub_decay):
+                    if gscale_ is not None:
+                        gs = [
+                            (g.astype(jnp.float32) * gscale_).astype(g.dtype)
+                            for g in gs
+                        ]
+                    elif self._grad_clip is not None:
+                        gs = self._grad_clip.clip_values(gs)
+                    outs = [
+                        self._apply_one(p, g, s, lr_, st_, decay=d)
+                        for p, g, s, d in zip(ps, gs, ss, _decay)
+                    ]
+                    return [o[0] for o in outs], [o[1] for o in outs]
+
+                self._jit_cache[cache_key] = jax.jit(
+                    fused, static_argnames=()
+                )
+            jitted = self._jit_cache[cache_key]
+            new_p, new_s = jitted(
+                [p_vals[i] for i in idxs],
+                [g_vals[i] for i in idxs],
+                [s_vals[i] for i in idxs],
+                lr, step_no, gscale,
+            )
+            for j, i in enumerate(idxs):
+                params[i]._value = new_p[j]
+                self._states[id(params[i])] = new_s[j]
         self._step_count += 1
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):

@@ -1,0 +1,5 @@
+"""Mesh/sharding substrate shared by fleet, auto_parallel and the models."""
+from .mesh import (  # noqa: F401
+    set_mesh, get_mesh, has_mesh, mesh_axis_size, shard_value,
+    constraint, replicate_value, MeshScope,
+)

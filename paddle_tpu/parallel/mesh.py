@@ -1,0 +1,119 @@
+"""Global device-mesh state — the TPU-native replacement for the
+reference's communication-group machinery (SURVEY.md §2.3 TPU mapping).
+
+Where the reference builds ProcessGroupNCCL rings per topology axis, here
+``fleet.init`` (or auto-parallel) installs ONE ``jax.sharding.Mesh`` with
+named axes (``dp``, ``sharding``, ``sep``, ``mp`` — pipeline stages get
+per-stage sub-meshes) and layers place/constrain arrays with
+``PartitionSpec``s; XLA GSPMD inserts the ICI collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_GLOBAL_MESH: Mesh | None = None
+
+
+def set_mesh(mesh: Mesh | None):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Mesh | None:
+    return _GLOBAL_MESH
+
+
+def has_mesh() -> bool:
+    return _GLOBAL_MESH is not None
+
+
+def mesh_axis_size(axis: str) -> int:
+    if _GLOBAL_MESH is None or axis not in _GLOBAL_MESH.shape:
+        return 1
+    return int(_GLOBAL_MESH.shape[axis])
+
+
+class MeshScope:
+    """Temporarily install a mesh (used by per-stage pipeline execution)."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        global _GLOBAL_MESH
+        self._saved = _GLOBAL_MESH
+        _GLOBAL_MESH = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        global _GLOBAL_MESH
+        _GLOBAL_MESH = self._saved
+        return False
+
+
+def _named_sharding(spec):
+    if _GLOBAL_MESH is None:
+        return None
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    # drop axis names the mesh doesn't have (e.g. sep unused)
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in _GLOBAL_MESH.shape)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in _GLOBAL_MESH.shape else None)
+    return NamedSharding(_GLOBAL_MESH, PartitionSpec(*cleaned))
+
+
+def _divisible(value, spec):
+    """Check every sharded dim divides by the axis size product."""
+    if _GLOBAL_MESH is None:
+        return False
+    shape = np.shape(value)
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        size = 1
+        for a in axes:
+            size *= int(_GLOBAL_MESH.shape.get(a, 1))
+        if size > 1 and (dim >= len(shape) or shape[dim] % size != 0):
+            return False
+    return True
+
+
+def shard_value(value, *spec):
+    """device_put a concrete array with the given PartitionSpec entries
+    (falls back to replication for non-divisible dims)."""
+    sharding = _named_sharding(spec)
+    if sharding is None:
+        return value
+    if not _divisible(value, tuple(spec)):
+        sharding = _named_sharding(())
+    return jax.device_put(value, sharding)
+
+
+def replicate_value(value):
+    sharding = _named_sharding(())
+    if sharding is None:
+        return value
+    return jax.device_put(value, sharding)
+
+
+def constraint(value, *spec):
+    """Sharding constraint usable both eagerly and inside traces; identity
+    when no mesh is installed (single-device runs stay zero-cost)."""
+    sharding = _named_sharding(spec)
+    if sharding is None:
+        return value
+    if isinstance(value, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(value, sharding)
+    if not _divisible(value, tuple(spec)):
+        return value
+    return jax.device_put(value, sharding)

@@ -1,0 +1,299 @@
+"""Distributed stack tests on the 8-device CPU mesh.
+
+The key oracle (SURVEY.md §4): parallel == serial numerics — hybrid
+sharded/TP/PP runs must match a plain single-logical-device run.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.parallel import mesh as mesh_state
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    mesh_state.set_mesh(None)
+
+
+def _init(dp=1, mp=1, pp=1, sharding=1, acc_steps=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+        "sharding_degree": sharding,
+    }
+    strategy.pipeline_configs = {"accumulate_steps": acc_steps}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def test_topology_ranks():
+    topo = fleet.CommunicateTopology(
+        ("data", "pipe", "sharding", "sep", "model"), (2, 2, 1, 1, 2)
+    )
+    assert topo.world_size() == 8
+    assert topo.get_rank(data=0, pipe=0, sharding=0, sep=0, model=1) == 1
+    coord = topo.get_coord(5)
+    assert topo.get_rank(**coord) == 5
+    groups = topo.get_comm_list("model")
+    assert all(len(g) == 2 for g in groups)
+
+
+def test_fleet_init_builds_mesh():
+    _init(dp=2, mp=2, sharding=2)
+    m = mesh_state.get_mesh()
+    assert m.shape["dp"] == 2 and m.shape["mp"] == 2 and m.shape["sharding"] == 2
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 2
+
+
+def test_tp_parallel_equals_serial():
+    _init(mp=2)
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear,
+    )
+
+    paddle.seed(0)
+    col = ColumnParallelLinear(8, 16, has_bias=True, gather_output=False)
+    row = RowParallelLinear(16, 8, has_bias=True, input_is_parallel=True)
+    x = paddle.randn([4, 8])
+    out = row(col(x))
+    ref = (
+        x.numpy() @ col.weight.numpy() + col.bias.numpy()
+    ) @ row.weight.numpy() + row.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+    # weights actually sharded over mp
+    assert "mp" in str(col.weight._value.sharding.spec)
+
+
+def test_vocab_parallel_embedding():
+    _init(mp=2)
+    from paddle_tpu.distributed.fleet.meta_parallel import VocabParallelEmbedding
+
+    emb = VocabParallelEmbedding(16, 8)
+    ids = paddle.to_tensor([[1, 3], [5, 15]])
+    out = emb(ids)
+    np.testing.assert_allclose(
+        out.numpy(), emb.weight.numpy()[ids.numpy()], rtol=1e-6
+    )
+
+
+def test_tp_training_matches_serial():
+    """Same seed+data: mp-sharded model == unsharded model after k steps."""
+    import copy
+
+    def build_and_train(use_mesh):
+        mesh_state.set_mesh(None)
+        if use_mesh:
+            _init(mp=2)
+        paddle.seed(42)
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear, RowParallelLinear,
+        )
+
+        col = ColumnParallelLinear(8, 16, has_bias=True, gather_output=False)
+        row = RowParallelLinear(16, 4, has_bias=True, input_is_parallel=True)
+        params = col.parameters() + row.parameters()
+        opt = paddle.optimizer.SGD(0.1, parameters=params)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        for _ in range(3):
+            loss = F.cross_entropy(row(col(x)), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return float(loss), [p.numpy().copy() for p in params]
+
+    loss_p, params_p = build_and_train(True)
+    loss_s, params_s = build_and_train(False)
+    np.testing.assert_allclose(loss_p, loss_s, rtol=1e-4)
+    for a, b in zip(params_p, params_s):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_group_sharded_stage3_equals_serial():
+    def run(level):
+        mesh_state.set_mesh(None)
+        if level:
+            _init(sharding=4)
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(0.05, parameters=m.parameters())
+        if level:
+            from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+            m2, opt, _ = group_sharded_parallel(m, opt, level)
+        else:
+            m2 = m
+        x = paddle.to_tensor(np.random.RandomState(1).randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(np.arange(8) % 4)
+        for _ in range(3):
+            loss = F.cross_entropy(m2(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return float(loss), [p.numpy().copy() for p in m.parameters()]
+
+    for level in ("os", "os_g", "p_g_os"):
+        loss_p, params_p = run(level)
+        loss_s, params_s = run(None)
+        np.testing.assert_allclose(loss_p, loss_s, rtol=1e-4, err_msg=level)
+        for a, b in zip(params_p, params_s):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5, err_msg=level)
+
+
+def test_pipeline_parallel_trains():
+    _init(dp=2, mp=2, pp=2, acc_steps=4)
+    paddle.seed(0)
+    descs = [
+        fleet.LayerDesc(nn.Linear, 8, 32),
+        fleet.LayerDesc(nn.ReLU),
+        fleet.LayerDesc(nn.Linear, 32, 32),
+        fleet.LayerDesc(nn.ReLU),
+        fleet.LayerDesc(nn.Linear, 32, 4),
+    ]
+    pipe = fleet.PipelineLayer(layers=descs, loss_fn=nn.CrossEntropyLoss())
+    model = fleet.distributed_model(pipe)
+    assert type(model).__name__ == "PipelineParallel"
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(0.01, parameters=pipe.parameters())
+    )
+    x = paddle.randn([8, 8])
+    y = paddle.randint(0, 4, [8])
+    losses = [float(model.train_batch((x, y), opt)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    # params of stage-1 layers live on the second stage's devices
+    hcg = fleet.get_hybrid_communicate_group()
+    stage1_layer = next(
+        l for l in pipe.get_stage_items(1) if isinstance(l, nn.Linear)
+    )
+    devs = {d.id for d in stage1_layer.weight._value.sharding.device_set}
+    expected = {d.id for d in np.asarray(hcg.get_stage_mesh(1).devices).ravel()}
+    assert devs == expected
+
+
+def test_pipeline_equals_serial():
+    """pp=2 with microbatching == serial run on the same data/weights."""
+
+    def run(pp):
+        mesh_state.set_mesh(None)
+        _init(pp=pp, acc_steps=4 if pp > 1 else 1)
+        paddle.seed(5)
+        descs = [
+            fleet.LayerDesc(nn.Linear, 8, 16),
+            fleet.LayerDesc(nn.ReLU),
+            fleet.LayerDesc(nn.Linear, 16, 4),
+        ]
+        pipe = fleet.PipelineLayer(layers=descs, loss_fn=nn.CrossEntropyLoss())
+        opt = paddle.optimizer.SGD(0.1, parameters=pipe.parameters())
+        x = paddle.to_tensor(np.random.RandomState(2).randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(np.arange(8) % 4)
+        if pp > 1:
+            model = fleet.distributed_model(pipe)
+            for _ in range(3):
+                loss = model.train_batch((x, y), opt)
+        else:
+            for _ in range(3):
+                out = pipe(x)
+                loss = nn.CrossEntropyLoss()(out, y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+        return [p.numpy().copy() for p in pipe.parameters()]
+
+    params_pp = run(2)
+    params_serial = run(1)
+    for a, b in zip(params_pp, params_serial):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_parallel_linears():
+    _init(mp=2)
+    from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
+    )
+
+    paddle.seed(0)
+    col = ColumnSequenceParallelLinear(8, 16, has_bias=True)
+    row = RowSequenceParallelLinear(16, 8, has_bias=True)
+    x = paddle.randn([4, 2, 8])  # (seq, batch, hidden)
+    xs = ScatterOp.apply(x)
+    out = row(col(xs))
+    ref = (
+        x.numpy() @ col.weight.numpy() + col.bias.numpy()
+    ) @ row.weight.numpy() + row.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_matches_direct():
+    paddle.seed(0)
+    block = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+    out1 = fleet.recompute(block, x)
+    out1.sum().backward()
+    g1 = x.grad.numpy().copy()
+    w_g1 = block[0].weight.grad.numpy().copy()
+    x.clear_grad()
+    block[0].weight.clear_grad()
+    out2 = block(x)
+    out2.sum().backward()
+    np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(g1, x.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(w_g1, block[0].weight.grad.numpy(), rtol=1e-5)
+
+
+def test_data_parallel_wrapper():
+    _init(dp=8)
+    m = paddle.DataParallel(nn.Linear(4, 2)) if hasattr(paddle, "DataParallel") else dist.DataParallel(nn.Linear(4, 2))
+    x = paddle.randn([16, 4])
+    out = m(x)
+    assert out.shape == [16, 2]
+    out.sum().backward()
+    assert m._layers.weight.grad is not None
+
+
+def test_collective_api_single_controller():
+    dist.init_parallel_env()
+    assert dist.get_world_size() == 1
+    t = paddle.to_tensor([1.0, 2.0])
+    out = dist.all_reduce(t)
+    np.testing.assert_allclose(out.numpy(), [1, 2])
+    gathered = []
+    dist.all_gather(gathered, t)
+    assert len(gathered) == 1
+    dist.barrier()
+
+
+def test_shard_tensor_api():
+    from paddle_tpu.distributed.auto_parallel import (
+        ProcessMesh, shard_tensor, Shard, Replicate,
+    )
+
+    mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    t = paddle.ones([8, 4])
+    st = shard_tensor(t, mesh, [Shard(0), Replicate()])
+    spec = st._value.sharding.spec
+    assert spec[0] == "x"
+    np.testing.assert_allclose(st.numpy(), np.ones((8, 4)))
+
+
+def test_dist_checkpoint_roundtrip(tmp_path):
+    _init(sharding=4)
+    from paddle_tpu.distributed.checkpoint import save_state_dict, load_state_dict
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    m = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    group_sharded_parallel(m, opt, "p_g_os")
+    w_ref = m.weight.numpy().copy()
+    save_state_dict(m.state_dict(), str(tmp_path))
+    m.weight.set_value(np.zeros_like(w_ref))
+    load_state_dict(m.state_dict(), str(tmp_path))
+    np.testing.assert_allclose(m.weight.numpy(), w_ref)
+    # sharding preserved after load
+    assert "sharding" in str(m.weight._value.sharding.spec)
