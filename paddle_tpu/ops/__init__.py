@@ -1,0 +1,8 @@
+"""paddle_tpu.ops — native-kernel tier (Pallas on TPU).
+
+The reference ships CUDA ``fused_*`` kernels (SURVEY.md §2.5); here the
+equivalents are Pallas TPU kernels with XLA fallbacks, dispatched through
+the same functional surface (F.scaled_dot_product_attention, F.rms_norm,
+incubate.fused_multi_transformer).
+"""
+from . import pallas  # noqa: F401

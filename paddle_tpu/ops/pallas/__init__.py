@@ -1,0 +1,3 @@
+from .flash_attention import flash_attention  # noqa: F401
+from .rms_norm import rms_norm  # noqa: F401
+from .decode_attention import decode_attention  # noqa: F401
