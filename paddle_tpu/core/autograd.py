@@ -196,11 +196,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
                 "backward() called on a tensor with stop_gradient=True"
             )
         if g is None:
-            if t.size != 1:
-                raise RuntimeError(
-                    "grad must be provided for non-scalar backward roots; "
-                    f"got shape {t.shape}"
-                )
+            # paddle fills the initial gradient with ones for roots of any
+            # shape (grad_tensor=None semantics), not just scalars
             g = jnp.ones(t._value.shape, t._value.dtype)
         else:
             g = g._value if isinstance(g, Tensor) else jnp.asarray(g)

@@ -67,6 +67,8 @@ def get_flags(flags) -> dict:
 define_flag("FLAGS_check_nan_inf", False, "per-op NaN/Inf scan in eager mode")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "accepted for compat; XLA manages memory")
 define_flag("FLAGS_use_pallas_kernels", True, "route hot ops to Pallas kernels on TPU")
+define_flag("FLAGS_pallas_force", False,
+            "route to Pallas kernels even off-TPU (interpret mode; for tests)")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "accepted for compat")
 define_flag("FLAGS_cudnn_deterministic", False, "accepted for compat; XLA is deterministic")
 define_flag("FLAGS_embedding_deterministic", False, "accepted for compat")

@@ -331,11 +331,14 @@ class DataLoader:
         # background-thread prefetch pipeline
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
         sentinel = object()
+        error: list = []
 
         def producer():
             try:
                 for item in self._fetch_iter():
                     q.put(item)
+            except BaseException as e:  # propagate to the consumer, don't
+                error.append(e)         # silently truncate the epoch
             finally:
                 q.put(sentinel)
 
@@ -347,3 +350,5 @@ class DataLoader:
                 break
             yield item
         t.join()
+        if error:
+            raise error[0]

@@ -1,0 +1,206 @@
+"""Fully-jitted training step — the perf path of the framework.
+
+The reference reaches peak throughput through static-graph execution with
+fused ops (SURVEY.md §3.2/§3.3); the TPU-native equivalent is ONE
+``jax.jit``-compiled function per training step: forward (via
+``functional_call`` on the live Layer), loss, backward (``jax.grad``),
+and the optimizer's functional multi-tensor update — all fused by XLA,
+with parameter/state buffers donated so updates are in-place in HBM.
+
+Under a ``jax.sharding.Mesh`` the params/opt-states are already placed
+with NamedShardings (fleet TP layers / ZeRO state sharding); jit infers
+in-shardings from placement and GSPMD inserts the ICI collectives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from . import functional_call
+from ..parallel import mesh as mesh_state
+
+__all__ = ["JittedTrainStep"]
+
+
+class JittedTrainStep:
+    """Compile the whole (forward, loss, backward, update) into one XLA
+    program.
+
+    Args:
+        model: nn.Layer (params may carry NamedShardings from TP layers).
+        criterion: callable(model_output, *labels) -> scalar loss Tensor.
+        optimizer: paddle_tpu Optimizer (its functional bridge is used;
+            the live optimizer object's state is NOT consumed).
+        state_sharding_axis: optional mesh axis name — optimizer states
+            are sharded over it along dim 0 when divisible (ZeRO-1/2: the
+            reference's GroupShardedOptimizerStage2 semantics).
+        input_batch_axes: mesh axes for the leading (batch) dim of every
+            input (default ``("dp",)`` when a mesh is installed).
+        donate: donate param/state buffers (in-place HBM update).
+    """
+
+    def __init__(self, model, criterion, optimizer,
+                 state_sharding_axis=None, input_batch_axes=None,
+                 donate=True):
+        self._model = model
+        self._criterion = criterion
+        self._optimizer = optimizer
+        self._params = [p for _, p in model.named_parameters()]
+        self._buffers = [b for _, b in model.named_buffers()]
+        self._p_vals = [p._value for p in self._params]
+        self._b_vals = [b._value for b in self._buffers]
+        self._s_vals = optimizer.functional_state_init(self._p_vals)
+        self._decay_flags = [optimizer._decay_enabled(p) for p in self._params]
+        self._step_no = 0
+        self._input_batch_axes = input_batch_axes
+        if state_sharding_axis and mesh_state.has_mesh():
+            self._s_vals = _shard_states(self._s_vals, state_sharding_axis)
+
+        model_ref = model
+        criterion_ref = criterion
+        opt_ref = optimizer
+        decay_flags = self._decay_flags
+
+        def one_step(p_vals, s_vals, b_vals, rng, lr, step_no, inputs, labels):
+            from ..core.random import traced_key_scope
+
+            def loss_of(pv):
+                in_t = [Tensor(x, stop_gradient=True) for x in inputs]
+                lb_t = [Tensor(x, stop_gradient=True) for x in labels]
+                with autograd.no_grad(), traced_key_scope(rng):
+                    def fwd_and_loss(*args):
+                        n_in = len(in_t)
+                        out = model_ref(*args[:n_in])
+                        return criterion_ref(out, *args[n_in:])
+
+                    loss_t, new_b = functional_call(
+                        model_ref, fwd_and_loss, in_t + lb_t, {}, pv, b_vals
+                    )
+                return loss_t._value, new_b
+
+            (loss, new_b), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(p_vals)
+            new_p, new_s = opt_ref.functional_apply(
+                p_vals, grads, s_vals, lr, step_no, decay_flags)
+            return loss, new_p, new_s, new_b
+
+        def step_fn(p_vals, s_vals, b_vals, rng, lr, step_no, inputs, labels):
+            return one_step(p_vals, s_vals, b_vals, rng, lr, step_no,
+                            inputs, labels)
+
+        def multi_step_fn(p_vals, s_vals, b_vals, rng, lr, step0,
+                          inputs_stacked, labels_stacked):
+            # K train steps in ONE XLA program (lax.scan over the batch
+            # stack): amortizes host dispatch — the TPU-native analog of
+            # the reference Executor running a multi-iteration program
+            def body(carry, xs):
+                p, s, b, step_no = carry
+                in_i, lb_i = xs
+                rng_i = jax.random.fold_in(rng, step_no)
+                loss, p, s, b = one_step(p, s, b, rng_i, lr, step_no,
+                                         in_i, lb_i)
+                return (p, s, b, step_no + 1), loss
+
+            (p, s, b, _), losses = jax.lax.scan(
+                body, (p_vals, s_vals, b_vals, step0),
+                (inputs_stacked, labels_stacked))
+            return losses, p, s, b
+
+        donate_args = (0, 1, 2) if donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate_args)
+        self._jitted_multi = jax.jit(multi_step_fn, donate_argnums=donate_args)
+
+    def __call__(self, inputs, labels):
+        """inputs/labels: Tensor or list of Tensors. Returns loss Tensor."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        in_vals = [self._place_input(t) for t in inputs]
+        lb_vals = [self._place_input(t) for t in labels]
+        from ..core.random import next_key
+
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self._step_no + 1, jnp.int32)
+        loss, self._p_vals, self._s_vals, self._b_vals = self._jitted(
+            self._p_vals, self._s_vals, self._b_vals, next_key(), lr,
+            step_no, in_vals, lb_vals,
+        )
+        self._step_no += 1
+        return Tensor(loss)
+
+    def run_steps(self, inputs_stacked, labels_stacked):
+        """Run K train steps in ONE dispatch. inputs/labels carry a leading
+        step dim (K, batch, ...); returns the (K,) per-step losses."""
+        if not isinstance(inputs_stacked, (list, tuple)):
+            inputs_stacked = [inputs_stacked]
+        if not isinstance(labels_stacked, (list, tuple)):
+            labels_stacked = [labels_stacked]
+        in_vals = [self._place_input(t, stacked=True) for t in inputs_stacked]
+        lb_vals = [self._place_input(t, stacked=True) for t in labels_stacked]
+        from ..core.random import next_key
+
+        k = in_vals[0].shape[0]
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        step0 = jnp.asarray(self._step_no + 1, jnp.int32)
+        losses, self._p_vals, self._s_vals, self._b_vals = self._jitted_multi(
+            self._p_vals, self._s_vals, self._b_vals, next_key(), lr,
+            step0, in_vals, lb_vals,
+        )
+        self._step_no += k
+        return Tensor(losses)
+
+    def _place_input(self, t, stacked=False):
+        v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+        if mesh_state.has_mesh():
+            axes = self._input_batch_axes
+            if axes is None:
+                axes = ("dp",) if mesh_state.mesh_axis_size("dp") > 1 else ()
+            if axes:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                lead = [None] if stacked else []
+                spec = PartitionSpec(
+                    *lead, axes, *([None] * (v.ndim - len(lead) - 1)))
+                v = jax.device_put(
+                    v, NamedSharding(mesh_state.get_mesh(), spec))
+        return v
+
+    def sync_to_model(self):
+        """Write the jitted state back to the live Layer/Optimizer (for
+        save/load or switching to eager)."""
+        for p, v in zip(self._params, self._p_vals):
+            p._value = v
+        for b, v in zip(self._buffers, self._b_vals):
+            b._value = v
+        for p, s in zip(self._params, self._s_vals):
+            self._optimizer._states[id(p)] = s
+        self._optimizer._step_count = self._step_no
+
+    @property
+    def params(self):
+        return self._p_vals
+
+
+def _shard_states(states, axis):
+    """Place optimizer state arrays sharded over ``axis`` (dim 0 when
+    divisible) — ZeRO-1/2 optimizer-state partitioning on the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = mesh_state.get_mesh()
+    size = mesh_state.mesh_axis_size(axis)
+    if size <= 1:
+        return states
+
+    def place(v):
+        if not isinstance(v, jax.Array) or v.ndim == 0:
+            return v
+        if v.shape[0] % size == 0:
+            spec = PartitionSpec(axis, *([None] * (v.ndim - 1)))
+        else:
+            spec = PartitionSpec(*([None] * v.ndim))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, states)

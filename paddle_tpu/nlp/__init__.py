@@ -1,0 +1,17 @@
+"""paddle_tpu.nlp — flagship language-model family.
+
+The reference keeps its LLM zoo in PaddleNLP (SURVEY.md §6: the Llama-2-7B
+Fleet hybrid-parallel config is the north-star benchmark); this module
+provides the TPU-native equivalent built on the framework's own surface
+(nn.Layer, fleet TP layers, Pallas flash attention, fused rope).
+"""
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaAttention,
+    LlamaMLP,
+    LlamaDecoderLayer,
+    LlamaModel,
+    LlamaForCausalLM,
+    LlamaPretrainingCriterion,
+)
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401

@@ -1,0 +1,158 @@
+"""GPT-2 family — BASELINE.md config #2 (GPT-2 345M, static graph /
+``to_static`` + XLA fusion, the reference's "CINN" story).
+
+Pre-LN transformer with learned positional embeddings and GELU MLP;
+the same fleet TP tier as the Llama model when an mp mesh axis exists.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding, Dropout
+from ..nn.layer.norm import LayerNorm
+from ..nn import functional as F
+from ..parallel import mesh as mesh_state
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=1024,
+                 num_hidden_layers=24, num_attention_heads=16,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 layer_norm_epsilon=1e-5, dropout=0.0,
+                 tensor_parallel=False, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.dropout = dropout
+        self.tensor_parallel = tensor_parallel
+        self.dtype = dtype
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def gpt2_345m(**overrides):
+        cfg = dict(vocab_size=50304, hidden_size=1024, num_hidden_layers=24,
+                   num_attention_heads=16, max_position_embeddings=1024)
+        cfg.update(overrides)
+        return GPTConfig(**cfg)
+
+    @staticmethod
+    def tiny(**overrides):
+        cfg = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, max_position_embeddings=128)
+        cfg.update(overrides)
+        return GPTConfig(**cfg)
+
+
+def _use_mp(config):
+    # fleet mp layers degrade to serial layers without a mesh (keeps init
+    # identical for the parallel==serial oracle)
+    return config.tensor_parallel
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, d = config.num_attention_heads, config.head_dim
+        self.num_heads, self.head_dim = h, d
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear,
+            )
+
+            self.qkv = ColumnParallelLinear(
+                config.hidden_size, 3 * h * d, has_bias=True,
+                gather_output=False)
+            self.out_proj = RowParallelLinear(
+                h * d, config.hidden_size, has_bias=True,
+                input_is_parallel=True)
+            self.fc_in = ColumnParallelLinear(
+                config.hidden_size, config.intermediate_size, has_bias=True,
+                gather_output=False)
+            self.fc_out = RowParallelLinear(
+                config.intermediate_size, config.hidden_size, has_bias=True,
+                input_is_parallel=True)
+        else:
+            self.qkv = Linear(config.hidden_size, 3 * h * d)
+            self.out_proj = Linear(h * d, config.hidden_size)
+            self.fc_in = Linear(config.hidden_size, config.intermediate_size)
+            self.fc_out = Linear(config.intermediate_size, config.hidden_size)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, hidden):
+        b, s, _ = hidden.shape
+        x = self.ln_1(hidden)
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = attn.reshape([b, s, self.num_heads * self.head_dim])
+        hidden = hidden + self.dropout(self.out_proj(attn))
+        x = self.ln_2(hidden)
+        hidden = hidden + self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+        return hidden
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                VocabParallelEmbedding,
+            )
+
+            self.wte = VocabParallelEmbedding(config.vocab_size,
+                                              config.hidden_size)
+        else:
+            self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size)
+        self.blocks = []
+        for i in range(config.num_hidden_layers):
+            blk = GPTBlock(config)
+            self.add_sublayer(f"h.{i}", blk)
+            self.blocks.append(blk)
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        import paddle_tpu as paddle
+
+        s = input_ids.shape[1]
+        pos = paddle.arange(s).unsqueeze(0)
+        hidden = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            hidden = blk(hidden)
+        return self.ln_f(hidden)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear,
+            )
+
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids):
+        return self.lm_head(self.gpt(input_ids))

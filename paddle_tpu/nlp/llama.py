@@ -1,0 +1,358 @@
+"""Llama-family model — the north-star workload (BASELINE.md config #3:
+Llama-2-7B, Fleet hybrid TP×PP×sharding-3, ≥45% MFU target).
+
+TPU-first design notes:
+- Attention routes through F.scaled_dot_product_attention → the Pallas
+  flash kernel on TPU (GQA consumed natively via the kernel's KV-head
+  index map, no repeat materialisation).
+- RMSNorm routes to the Pallas rms_norm kernel; rotary embedding is the
+  fused_rope functional (pure-XLA elementwise, fused by the compiler).
+- Tensor parallelism is the fleet mp-layer tier: Column/RowParallelLinear
+  and VocabParallelEmbedding place weights with NamedShardings over the
+  ``mp`` mesh axis and GSPMD inserts the collectives — no explicit
+  all-reduce calls anywhere in the model.
+- Sequence parallelism marks hidden states sharded over ``sep`` between
+  the attention blocks; activations inside attention gather via the same
+  GSPMD propagation.
+- With no mesh installed every class degrades to plain serial layers, so
+  the same model file serves the single-chip and multi-chip paths.
+"""
+from __future__ import annotations
+
+import math
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding
+from ..nn.layer.norm import RMSNorm
+from ..nn import functional as F
+from ..nn.functional.rope import build_rope_cache, apply_rotary_emb
+from ..tensor._helpers import apply, ensure_tensor
+from ..parallel import mesh as mesh_state
+
+__all__ = [
+    "LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
+    "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion",
+]
+
+
+class LlamaConfig:
+    """Configuration (mirrors the HF/PaddleNLP llama config fields that
+    matter for pretraining)."""
+
+    def __init__(self, vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=None,
+                 max_position_embeddings=4096, rms_norm_eps=1e-6,
+                 rope_theta=10000.0, tie_word_embeddings=False,
+                 tensor_parallel=True, sequence_parallel=False,
+                 use_recompute=False, recompute_granularity="full",
+                 dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.tie_word_embeddings = tie_word_embeddings
+        self.tensor_parallel = tensor_parallel
+        self.sequence_parallel = sequence_parallel
+        self.use_recompute = use_recompute
+        self.recompute_granularity = recompute_granularity
+        self.dtype = dtype
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama2_7b(**overrides):
+        cfg = dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                   num_hidden_layers=32, num_attention_heads=32,
+                   max_position_embeddings=4096)
+        cfg.update(overrides)
+        return LlamaConfig(**cfg)
+
+    @staticmethod
+    def tiny(**overrides):
+        """Test-scale config used by the CI suite and the multichip dryrun."""
+        cfg = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=256)
+        cfg.update(overrides)
+        return LlamaConfig(**cfg)
+
+
+def _use_mp(config):
+    # The fleet mp layers degrade to plain serial layers when no mesh is
+    # installed, so gating on the config alone keeps initialization (and
+    # the parallel==serial oracle) identical across runs.
+    return config.tensor_parallel
+
+
+def _mark_hidden(t, config):
+    """Constrain hidden states (B, S, E): batch over dp(+sharding as fsdp
+    data axis), seq over sep when sequence-parallel."""
+    if not mesh_state.has_mesh():
+        return t
+    seq_axis = "sep" if (config.sequence_parallel
+                         and mesh_state.mesh_axis_size("sep") > 1) else None
+
+    def fn(v):
+        return mesh_state.constraint(v, "dp", seq_axis, None)
+
+    return apply(fn, ensure_tensor(t), op_name="hidden_constraint")
+
+
+class LlamaAttention(Layer):
+    """Self-attention with rotary embedding, GQA, and optional KV cache.
+
+    Reference shape: PaddleNLP LlamaAttention; the fused inference analog
+    is fused_multi_transformer (SURVEY.md §2.5) — here the train path uses
+    the Pallas flash kernel and the decode path the Pallas decode kernel.
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, hk, d = (config.num_attention_heads, config.num_key_value_heads,
+                    config.head_dim)
+        self.num_heads, self.num_kv_heads, self.head_dim = h, hk, d
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear,
+            )
+
+            self.q_proj = ColumnParallelLinear(
+                config.hidden_size, h * d, has_bias=False, gather_output=False)
+            self.k_proj = ColumnParallelLinear(
+                config.hidden_size, hk * d, has_bias=False, gather_output=False)
+            self.v_proj = ColumnParallelLinear(
+                config.hidden_size, hk * d, has_bias=False, gather_output=False)
+            self.o_proj = RowParallelLinear(
+                h * d, config.hidden_size, has_bias=False,
+                input_is_parallel=True)
+        else:
+            self.q_proj = Linear(config.hidden_size, h * d, bias_attr=False)
+            self.k_proj = Linear(config.hidden_size, hk * d, bias_attr=False)
+            self.v_proj = Linear(config.hidden_size, hk * d, bias_attr=False)
+            self.o_proj = Linear(h * d, config.hidden_size, bias_attr=False)
+
+    def forward(self, hidden, position_offset=0, cache=None):
+        b, s, _ = hidden.shape
+        q = self.q_proj(hidden).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+
+        cos, sin = build_rope_cache(
+            s, self.head_dim, base=self.config.rope_theta,
+            position_offset=position_offset,
+        )
+        q = apply(lambda t: apply_rotary_emb(t, cos, sin), q, op_name="rope_q")
+        k = apply(lambda t: apply_rotary_emb(t, cos, sin), k, op_name="rope_k")
+
+        if cache is not None:
+            # incremental decode: cache is (k_cache, v_cache) Tensors laid
+            # out (B, S_max, HK, D) with valid length = position_offset + s
+            k, v, cache = self._update_cache(k, v, cache, position_offset)
+            out = self._decode_attend(q, k, v, position_offset + s)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out), cache
+
+    def _update_cache(self, k, v, cache, position_offset):
+        import jax
+
+        kc = ensure_tensor(cache[0])
+        vc = ensure_tensor(cache[1])
+        new_kc = apply(lambda c, n: jax.lax.dynamic_update_slice_in_dim(
+            c, n.astype(c.dtype), position_offset, axis=1), kc, k,
+            op_name="kv_cache_update")
+        new_vc = apply(lambda c, n: jax.lax.dynamic_update_slice_in_dim(
+            c, n.astype(c.dtype), position_offset, axis=1), vc, v,
+            op_name="kv_cache_update")
+        return new_kc, new_vc, (new_kc, new_vc)
+
+    def _decode_attend(self, q, k_cache, v_cache, valid_len):
+        """Single-step (or short-suffix) attention over the cache."""
+        import jax
+        import jax.numpy as jnp
+
+        def fn(qv, kc, vc):
+            b = qv.shape[0]
+            if qv.shape[1] == 1 and jax.default_backend() == "tpu":
+                from ..ops.pallas.decode_attention import decode_attention
+
+                lens = jnp.full((b,), valid_len, jnp.int32)
+                return decode_attention(qv, kc, vc, lens)
+            # prefill/suffix path: mask to the valid prefix
+            rep = qv.shape[2] // kc.shape[2]
+            kr = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+            vr = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+            sq, sk = qv.shape[1], kr.shape[1]
+            sc = 1.0 / math.sqrt(qv.shape[-1])
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", qv.astype(jnp.float32),
+                kr.astype(jnp.float32)) * sc
+            q_pos = valid_len - sq + jnp.arange(sq)
+            k_pos = jnp.arange(sk)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p, vr.astype(jnp.float32))
+            return out.astype(qv.dtype)
+
+        return apply(fn, q, k_cache, v_cache, op_name="decode_attention")
+
+
+class LlamaMLP(Layer):
+    """SwiGLU MLP: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear,
+            )
+
+            self.gate_proj = ColumnParallelLinear(
+                config.hidden_size, config.intermediate_size, has_bias=False,
+                gather_output=False)
+            self.up_proj = ColumnParallelLinear(
+                config.hidden_size, config.intermediate_size, has_bias=False,
+                gather_output=False)
+            self.down_proj = RowParallelLinear(
+                config.intermediate_size, config.hidden_size, has_bias=False,
+                input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(
+                config.hidden_size, config.intermediate_size, bias_attr=False)
+            self.up_proj = Linear(
+                config.hidden_size, config.intermediate_size, bias_attr=False)
+            self.down_proj = Linear(
+                config.intermediate_size, config.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden, position_offset=0, cache=None):
+        residual = hidden
+        attn_out, cache = self.self_attn(
+            self.input_layernorm(hidden), position_offset, cache)
+        hidden = residual + attn_out
+        hidden = _mark_hidden(hidden, self.config)
+        hidden = hidden + self.mlp(self.post_attention_layernorm(hidden))
+        hidden = _mark_hidden(hidden, self.config)
+        return hidden, cache
+
+    def forward_no_cache(self, hidden, position_offset=0):
+        """Single-output variant for the recompute (remat) wrapper."""
+        out, _ = self.forward(hidden, position_offset, None)
+        return out
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                VocabParallelEmbedding,
+            )
+
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = []
+        for i in range(config.num_hidden_layers):
+            layer = LlamaDecoderLayer(config)
+            self.add_sublayer(f"layers.{i}", layer)
+            self.layers.append(layer)
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_offset=0, caches=None):
+        hidden = self.embed_tokens(input_ids)
+        hidden = _mark_hidden(hidden, self.config)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            cache_i = caches[i] if caches is not None else None
+            if self.config.use_recompute and caches is None:
+                from ..distributed.fleet.utils.recompute import recompute
+
+                hidden = recompute(layer.forward_no_cache, hidden,
+                                   position_offset)
+            else:
+                hidden, cache_i = layer(hidden, position_offset, cache_i)
+            if new_caches is not None:
+                new_caches.append(cache_i)
+        return self.norm(hidden), new_caches
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if _use_mp(config):
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear,
+            )
+
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, position_offset=0, caches=None):
+        hidden, new_caches = self.llama(input_ids, position_offset, caches)
+        logits = self.lm_head(hidden)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def init_caches(self, batch_size, max_len, dtype=None):
+        """Allocate empty KV caches: list of (k, v) per layer,
+        (B, max_len, HK, D)."""
+        import paddle_tpu as paddle
+
+        cfg = self.config
+        caches = []
+        for _ in range(cfg.num_hidden_layers):
+            shape = [batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim]
+            k = paddle.zeros(shape, dtype or cfg.dtype)
+            v = paddle.zeros(shape, dtype or cfg.dtype)
+            caches.append((k, v))
+        return caches
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shifted next-token cross entropy (PaddleNLP parity)."""
+
+    def __init__(self, config: LlamaConfig = None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        shifted = logits[:, :-1, :]
+        targets = labels[:, 1:]
+        return F.cross_entropy(
+            shifted.reshape([-1, shifted.shape[-1]]),
+            targets.reshape([-1]),
+        )

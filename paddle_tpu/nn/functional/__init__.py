@@ -6,4 +6,5 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .rope import *  # noqa: F401,F403
 from . import flash_attention  # noqa: F401

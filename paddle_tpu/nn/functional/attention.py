@@ -9,6 +9,7 @@ softmax-attention elsewhere.
 from __future__ import annotations
 
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -16,10 +17,18 @@ import jax.numpy as jnp
 from ...tensor._helpers import Tensor, apply, ensure_tensor
 from ...core.flags import get_flags
 
+# Imported eagerly so a broken kernel package fails loudly at import time
+# instead of silently falling back at every call (round-1 advisor finding).
+from ...ops.pallas.flash_attention import flash_attention as _pallas_flash
+
 
 def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
                    key=None):
     """Reference attention in pure XLA ops; layout (B, S, H, D)."""
+    if k.shape[2] != q.shape[2]:  # GQA/MQA: repeat kv heads to q heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -47,23 +56,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  training=True, name=None):
     """Layout (batch, seq, num_heads, head_dim) — paddle's flash-attn layout."""
     query, key_, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
     use_pallas = (
-        get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"]
+        flags["FLAGS_use_pallas_kernels"]
         and attn_mask is None
         and (dropout_p == 0.0 or not training)
-        and jax.default_backend() == "tpu"
+        and (jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
         and query._value.shape[-1] >= 64
     )
     if use_pallas:
         try:
-            from ...ops.pallas.flash_attention import flash_attention
-
             return apply(
-                lambda q, k, v: flash_attention(q, k, v, causal=is_causal),
+                lambda q, k, v: _pallas_flash(q, k, v, causal=is_causal),
                 query, key_, value, op_name="flash_attention",
             )
-        except Exception:
-            pass
+        except ValueError as e:
+            # unsupported head config (e.g. H % HK != 0) — fall back, loudly
+            warnings.warn(
+                f"Pallas flash attention fell back to XLA: {e}", RuntimeWarning
+            )
 
     rng_key = None
     if dropout_p > 0.0 and training:
@@ -103,8 +114,9 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         name=None):
     """Varlen flash attention: (total_tokens, H, D) + cumulative seqlens.
 
-    Implemented as segment-masked attention — segments are derived from
-    cu_seqlens, the Pallas kernel consumes segment ids natively.
+    Implemented as segment-masked XLA attention (O(n^2) memory): segments
+    are derived from cu_seqlens and masked in the logits. A blockwise
+    Pallas varlen kernel is a future optimization.
     """
     query, key_, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     cu_q = ensure_tensor(cu_seqlens_q)

@@ -6,4 +6,14 @@ from .attention import (  # noqa: F401
     flash_attn_unpadded,
 )
 
-flash_attn_qkvpacked = None  # packed variants land with the decode stack
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """Packed QKV variant: qkv is (B, S, 3, H, D)."""
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+

@@ -8,10 +8,13 @@ fuses it fully).
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 
 from ...tensor._helpers import Tensor, apply, ensure_tensor
+from ...ops.pallas.rms_norm import rms_norm as _pallas_rms_norm
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
@@ -43,33 +46,44 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
-    """RMSNorm; the hot path of Llama-family models."""
+    """RMSNorm over dims [begin_norm_axis:]; the hot path of Llama-family
+    models. Routes to the Pallas kernel (normalized dims flattened to one
+    feature axis) with a warned XLA fallback."""
     x = ensure_tensor(x)
     from ...core.flags import get_flags
 
-    use_pallas = get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"]
+    ndim = x.ndim
+    axis0 = begin_norm_axis % ndim if begin_norm_axis is not None else ndim - 1
+    norm_axes = tuple(range(axis0, ndim))
+
+    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
+    use_pallas = flags["FLAGS_use_pallas_kernels"] and (
+        jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"]
+    )
     if use_pallas and weight is not None and bias is None:
         try:
-            from ...ops.pallas.rms_norm import rms_norm as pallas_rms_norm
+            def pk(v, w):
+                # flatten the normalized dims into one feature axis
+                lead = v.shape[:axis0]
+                out = _pallas_rms_norm(
+                    v.reshape(*lead, -1), w.reshape(-1), epsilon)
+                return out.reshape(v.shape)
 
-            return apply(
-                lambda v, w: pallas_rms_norm(v, w, epsilon),
-                x,
-                ensure_tensor(weight),
-                op_name="rms_norm",
-            )
-        except Exception:
-            pass  # fall back to the XLA path
+            return apply(pk, x, ensure_tensor(weight), op_name="rms_norm")
+        except Exception as e:  # Mosaic/VMEM limits → XLA path, loudly
+            warnings.warn(
+                f"Pallas rms_norm fell back to XLA: {e}", RuntimeWarning)
 
     def fn(v, *wb):
-        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        var = jnp.mean(
+            jnp.square(v.astype(jnp.float32)), axis=norm_axes, keepdims=True)
         out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
         i = 0
         if weight is not None:
-            out = out * wb[i]
+            out = out * wb[i].reshape(v.shape[axis0:])
             i += 1
         if bias is not None:
-            out = out + wb[i]
+            out = out + wb[i].reshape(v.shape[axis0:])
         return out
 
     args = [x]

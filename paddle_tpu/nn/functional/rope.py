@@ -1,0 +1,104 @@
+"""Rotary position embedding — the reference's ``fused_rope`` kernel
+(paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu — unverified,
+SURVEY.md §0/§2.5).
+
+On TPU the rotation is a handful of elementwise ops XLA fuses straight
+into the surrounding matmuls, so the "fused" kernel is simply the jnp
+expression; the paddle incubate API shape is preserved
+(``fused_rotary_position_embedding``).
+
+Layout: (batch, seq, heads, head_dim), rotating pairs of the head dim.
+``use_neox_rotary_style=True`` pairs (i, i + D/2) (Llama/NeoX);
+False pairs adjacent lanes (GPT-J style).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor._helpers import apply, ensure_tensor
+
+__all__ = [
+    "build_rope_cache", "apply_rotary_emb", "fused_rotary_position_embedding",
+]
+
+
+def build_rope_cache(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                     position_offset=0):
+    """Returns (cos, sin) of shape (seq_len, head_dim // 2)."""
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(position_offset, position_offset + seq_len,
+                     dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv_freq)  # (S, D/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary_emb(x, cos, sin, neox=True, position_ids=None):
+    """x: (B, S, H, D) jax array; cos/sin: (S, D/2) or broadcastable."""
+    if position_ids is not None:
+        cos = cos[position_ids]  # (B, S, D/2)
+        sin = sin[position_ids]
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    cos = cos.astype(jnp.float32)
+    sin = sin.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    d = x.shape[-1]
+    if neox:
+        x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        )
+    else:
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0,
+                                    time_major=False):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding parity.
+
+    q/k/v: (B, S, H, D) tensors; returns rotated (q, k, v) (None passthrough
+    for absent inputs). If sin/cos are None they are computed from
+    ``rotary_emb_base``. paddle passes sin/cos shaped (1, S, 1, D) where the
+    half-dim values are duplicated; (S, D/2) is also accepted.
+    """
+    tensors = [t for t in (q, k, v) if t is not None]
+    first = ensure_tensor(tensors[0])
+    b, s, h, d = first._value.shape
+
+    if cos is None or sin is None:
+        cos_a, sin_a = build_rope_cache(s, d, base=rotary_emb_base)
+    else:
+        cos_a = ensure_tensor(cos)._value
+        sin_a = ensure_tensor(sin)._value
+        cos_a = cos_a.reshape(cos_a.shape[-2], cos_a.shape[-1])
+        sin_a = sin_a.reshape(sin_a.shape[-2], sin_a.shape[-1])
+        if cos_a.shape[-1] == d:  # duplicated halves → take one
+            cos_a = cos_a[..., : d // 2]
+            sin_a = sin_a[..., : d // 2]
+
+    pos_a = ensure_tensor(position_ids)._value if position_ids is not None else None
+
+    def rot(t):
+        t = ensure_tensor(t)
+        return apply(
+            lambda v_: apply_rotary_emb(
+                v_, cos_a, sin_a, neox=use_neox_rotary_style,
+                position_ids=pos_a,
+            ),
+            t, op_name="fused_rope",
+        )
+
+    out = tuple(rot(t) if t is not None else None for t in (q, k, v))
+    return out

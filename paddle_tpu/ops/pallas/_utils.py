@@ -1,0 +1,13 @@
+"""Shared helpers for the Pallas kernel tier."""
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode():
+    """Pallas kernels run in interpret mode off-TPU (CPU test suite)."""
+    return jax.default_backend() != "tpu"
+
+
+def round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

@@ -6,7 +6,19 @@ unverified, SURVEY.md §0/§2.5).
 Blockwise online-softmax forward + recompute backward (dq and dk/dv
 kernels), wrapped in jax.custom_vjp. Public layout is paddle's
 (batch, seq, heads, head_dim); kernels run (batch, heads, seq, head_dim).
-Supports causal masking; sm_scale defaults to 1/sqrt(D).
+
+Notes on TPU legality (Mosaic lowering):
+- LSE is carried as (B, H, S, 1): a (1, 1, block_q, 1) block has its last
+  dim equal to the array dim (1) and second-to-last divisible by 8, which
+  lowers; a (1, 1, block_q) block does not (second-to-last dim 1).
+- Causal masking is bottom-right aligned (`q_pos + (sk - sq) >= k_pos`),
+  matching paddle / the XLA fallback's `tril(k=sk-sq)` when seq_q != seq_k.
+- Ragged sequence lengths are handled by padding to block multiples and
+  masking `k_pos >= sk` inside the kernel; padded query rows are sliced
+  off on exit.
+- GQA/MQA: forward and dq index the shared KV head via the BlockSpec index
+  map (no materialisation); only the dk/dv kernel sees KV repeated per
+  query head, with the per-group sum applied after.
 """
 from __future__ import annotations
 
@@ -18,21 +30,44 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+from ._utils import interpret_mode as _interpret_mode, round_up as _round_up
+
 NEG_INF = -1e30
 
 
-def _interpret_mode():
-    return jax.default_backend() != "tpu"
+def _default_blocks(head_dim):
+    """Measured on v5e: large blocks amortize the per-grid-step overhead —
+    (1024, 1024) is ~9x faster than (128, 128) for d=64 fwd+bwd. Halve as
+    head_dim grows to stay within VMEM."""
+    if head_dim <= 64:
+        return 1024, 1024
+    if head_dim <= 128:
+        return 512, 512
+    return 256, 256
+
+
+
+
+def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
+    """Boolean validity mask (BQ, BK) for one (q-block, kv-block) tile."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = k_pos < kv_len
+    if causal:
+        mask = mask & (q_pos + causal_offset >= k_pos)
+    return mask
 
 
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, causal, sm_scale, block_q, block_k,
-                kv_steps):
+                m_scr, l_scr, acc_scr, *, causal, causal_offset, kv_len,
+                sm_scale, block_q, block_k, kv_steps):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -42,12 +77,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = True
+    # skip kv blocks that are entirely invalid (causal future or padding)
+    run = ki * block_k < kv_len
     if causal:
-        # kv block strictly after the last q row of this block → skip
-        run = ki * block_k <= (qi + 1) * block_q - 1
+        run = run & (ki * block_k <= (qi + 1) * block_q - 1 + causal_offset)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)  # (BQ, D)
         k = k_ref[0, 0].astype(jnp.float32)  # (BK, D)
@@ -56,18 +91,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale  # (BQ, BK)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = _mask_for_block(qi, ki, block_q, block_k, causal,
+                               causal_offset, kv_len)
+        s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]  # (BQ, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        # fully-masked rows keep m=NEG_INF; mask p explicitly so
+        # exp(NEG_INF - NEG_INF) = 1 cannot leak in
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -81,19 +113,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = m_scr[:] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
+               block_q, block_k):
+    """q: (B,H,Sq,D) block-multiple padded; k/v: (B,HK,Sk,D)."""
     b, h, sq, d = q.shape
-    sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
     q_steps = pl.cdiv(sq, block_q)
     kv_steps = pl.cdiv(sk, block_k)
 
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, sm_scale=sm_scale,
+        _fwd_kernel, causal=causal, causal_offset=causal_offset,
+        kv_len=kv_len, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, kv_steps=kv_steps,
     )
     out, lse = pl.pallas_call(
@@ -101,16 +135,18 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
         grid=(b, h, q_steps, kv_steps),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, qi, ki: (b_, h_, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -126,7 +162,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 # backward: dq kernel (grid over q blocks, scan kv blocks)
 # --------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, causal, sm_scale, block_q, block_k, kv_steps):
+                   dq_scr, *, causal, causal_offset, kv_len, sm_scale,
+                   block_q, block_k, kv_steps):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -134,30 +171,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = True
+    run = ki * block_k < kv_len
     if causal:
-        run = ki * block_k <= (qi + 1) * block_q - 1
+        run = run & (ki * block_k <= (qi + 1) * block_q - 1 + causal_offset)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]  # (BQ,1)
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]    # (BQ, 1)
+        delta = delta_ref[0, 0]  # (BQ, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)  # softmax probabilities
+        mask = _mask_for_block(qi, ki, block_q, block_k, causal,
+                               causal_offset, kv_len)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -175,8 +206,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # backward: dk/dv kernel (grid over kv blocks, scan q blocks)
 # --------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, sm_scale,
-                    block_q, block_k, q_steps):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, causal_offset,
+                    kv_len, sm_scale, block_q, block_k, q_steps):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -185,31 +216,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = True
+    run = ki * block_k < kv_len
     if causal:
         # q block entirely before this kv block → no contribution
-        run = (qi + 1) * block_q - 1 >= ki * block_k
+        run = run & ((qi + 1) * block_q - 1 + causal_offset >= ki * block_k)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)  # (BQ, BK)
+        mask = _mask_for_block(qi, ki, block_q, block_k, causal,
+                               causal_offset, kv_len)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (BQ, BK)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -227,34 +252,46 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+def _flash_bwd(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
+               residuals, g):
     q, k, v, out, lse = residuals
     do = g[0] if isinstance(g, tuple) else g
     b, h, sq, d = q.shape
-    sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
     q_steps = pl.cdiv(sq, block_q)
     kv_steps = pl.cdiv(sk, block_k)
 
-    # delta = rowsum(do * out) — tiny, do it in XLA
+    # GQA: dq reads the shared KV head zero-copy via its index map (like
+    # the forward); only the dk/dv kernel needs KV materialised per query
+    # head, with the per-group reduction applied after.
+    if group > 1:
+        k_r = jnp.repeat(k, group, axis=1)
+        v_r = jnp.repeat(v, group, axis=1)
+    else:
+        k_r, v_r = k, v
+
+    # delta = rowsum(do * out) — tiny, do it in XLA; carried as (B,H,Sq,1)
     delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (B,H,Sq)
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
+
+    common = dict(causal=causal, causal_offset=causal_offset, kv_len=kv_len,
+                  sm_scale=sm_scale, block_q=block_q, block_k=block_k)
 
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, kv_steps=kv_steps,
-        ),
+        functools.partial(_bwd_dq_kernel, kv_steps=kv_steps, **common),
         grid=(b, h, q_steps, kv_steps),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, qi, ki: (b_, h_, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, qi, ki: (b_, h_, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)
@@ -264,19 +301,16 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
         interpret=_interpret_mode(),
     )(q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, q_steps=q_steps,
-        ),
+    dk_r, dv_r = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, q_steps=q_steps, **common),
         grid=(b, h, kv_steps, q_steps),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, ki, qi: (b_, h_, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, ki, qi: (b_, h_, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
@@ -291,51 +325,76 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret_mode(),
-    )(q, k, v, do, lse, delta)
+    )(q, k_r, v_r, do, lse, delta)
+
+    if group > 1:
+        dk = dk_r.reshape(b, hk, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv_r.reshape(b, hk, group, sk, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_r, dv_r
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------------
 # public API
 # --------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, causal, sm_scale, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_bhsd(q, k, v, causal, causal_offset, kv_len, sm_scale,
+                          block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
+                        block_q, block_k)
     return out
 
 
-def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+def _fwd_rule(q, k, v, causal, causal_offset, kv_len, sm_scale,
+              block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
+                          block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _bwd_rule(causal, sm_scale, block_q, block_k, residuals, g):
-    return _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g)
+def _bwd_rule(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
+              residuals, g):
+    return _flash_bwd(causal, causal_offset, kv_len, sm_scale,
+                      block_q, block_k, residuals, g)
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Flash attention over paddle layout (B, S, H, D)."""
+                    block_q=None, block_k=None):
+    """Flash attention over paddle layout (B, S, H, D).
+
+    Supports GQA/MQA (H a multiple of HK), cross-attention lengths
+    (bottom-right causal alignment), and arbitrary sequence lengths
+    (internally padded to block multiples).
+    """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    qt = jnp.swapaxes(q, 1, 2)
+    if block_q is None or block_k is None:
+        dbq, dbk = _default_blocks(q.shape[-1])
+        block_q = block_q or dbq
+        block_k = block_k or dbk
+    h, hk = q.shape[2], k.shape[2]
+    if h % hk != 0:
+        raise ValueError(f"query heads ({h}) must be a multiple of kv heads ({hk})")
+    qt = jnp.swapaxes(q, 1, 2)  # (B, H, Sq, D)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    # pad seq to block multiples (masked out by causal/softmax renorm)
     sq, sk = qt.shape[2], kt.shape[2]
-    bq = min(block_q, max(sq, 8))
-    bk = min(block_k, max(sk, 8))
+    bq = min(block_q, _round_up(sq, 8))
+    bk = min(block_k, _round_up(sk, 8))
     pad_q = (-sq) % bq
     pad_k = (-sk) % bk
-    if pad_q or pad_k:
-        # fall back to XLA attention on ragged shapes (simplicity; the
-        # training path uses block-multiple seq lens)
-        raise ValueError(
-            f"flash_attention requires seq multiples of block "
-            f"({bq}, {bk}); got q={sq}, k={sk}"
-        )
-    out = _flash_attention_bhsd(qt, kt, vt, causal, sm_scale, bq, bk)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    causal_offset = sk - sq  # bottom-right alignment, real lengths
+    out = _flash_attention_bhsd(qt, kt, vt, causal, causal_offset, sk,
+                                sm_scale, bq, bk)
+    if pad_q:
+        out = out[:, :, :sq]
     return jnp.swapaxes(out, 1, 2)

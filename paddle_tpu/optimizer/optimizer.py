@@ -99,26 +99,44 @@ class Optimizer:
 
     # -- functional bridge (used by fleet/hapi jitted train steps) ----------
     def functional_state_init(self, params_tree):
-        """Pytree of param arrays → pytree of state dicts."""
+        """Pytree of param arrays → pytree of state dicts (incl. master
+        weights for low-precision params when multi_precision)."""
+
+        def init(p):
+            st = self._init_state(p)
+            if self._multi_precision and p.dtype in (jnp.float16, jnp.bfloat16):
+                st["master"] = p.astype(jnp.float32)
+            return st
+
         return jax.tree_util.tree_map(
-            lambda p: self._init_state(p), params_tree,
+            init, params_tree,
             is_leaf=lambda x: isinstance(x, jax.Array),
         )
 
-    def functional_apply(self, params_tree, grads_tree, states_tree, lr, step):
-        """Pure pytree update (no Tensor objects) for jitted trainers."""
+    def functional_apply(self, params_tree, grads_tree, states_tree, lr, step,
+                         decay_flags=None):
+        """Pure pytree update (no Tensor objects) for jitted trainers.
 
-        def upd(p, g, st):
-            return self._apply_one(p, g, st, lr, step)
-
+        ``decay_flags``: optional pytree of bools (same structure) marking
+        which params receive weight decay (the eager path derives this
+        from ``param.no_weight_decay``/bias detection via _decay_enabled).
+        """
         flat_p, tdef = jax.tree_util.tree_flatten(
             params_tree, is_leaf=lambda x: isinstance(x, jax.Array)
         )
         flat_g = tdef.flatten_up_to(grads_tree)
         flat_s = tdef.flatten_up_to(states_tree)
+        if decay_flags is None:
+            flat_d = [True] * len(flat_p)
+        else:
+            flat_d = tdef.flatten_up_to(decay_flags)
+
+        def upd(p, g, st, d=True):
+            return self._apply_one(p, g, st, lr, step, decay=d)
         if self._grad_clip is not None:
             flat_g = self._grad_clip.clip_values(flat_g)
-        new = [upd(p, g, st) for p, g, st in zip(flat_p, flat_g, flat_s)]
+        new = [upd(p, g, st, d)
+               for p, g, st, d in zip(flat_p, flat_g, flat_s, flat_d)]
         new_p = jax.tree_util.tree_unflatten(tdef, [x[0] for x in new])
         new_s = jax.tree_util.tree_unflatten(tdef, [x[1] for x in new])
         return new_p, new_s
@@ -260,6 +278,7 @@ class Optimizer:
         self._step_count = state_dict.get("step_count", 0)
         if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
             self._lr.set_state_dict(dict(state_dict["LR_Scheduler"]))
+        missing = []
         for p in self._parameter_list or []:
             st = self._state_for(p)
             for k in list(st):
@@ -267,6 +286,20 @@ class Optimizer:
                 if key in state_dict:
                     v = state_dict[key]
                     st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                else:
+                    missing.append(key)
+        if missing:
+            # id-based fallback names differ across processes — a silent
+            # skip would reset accumulators to zero on resume
+            import warnings
+
+            warnings.warn(
+                f"optimizer.set_state_dict: {len(missing)} accumulator keys "
+                f"not found in the checkpoint (e.g. {missing[0]!r}); those "
+                "accumulators keep their current values. Name parameters via "
+                "Layer.create_parameter for stable keys.",
+                RuntimeWarning,
+            )
 
 
 class SGD(Optimizer):

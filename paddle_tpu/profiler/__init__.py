@@ -1,0 +1,192 @@
+"""paddle.profiler facade over jax.profiler (reference:
+python/paddle/profiler/profiler.py, C++ host/device tracers under
+paddle/fluid/platform/profiler/ — unverified, SURVEY.md §0/§5).
+
+The reference's CUPTI device tracer + chrome-trace exporter maps to XLA's
+XPlane tracing: ``Profiler`` drives ``jax.profiler.start_trace`` /
+``stop_trace`` (TensorBoard-loadable), ``RecordEvent`` maps to
+``jax.profiler.TraceAnnotation``, and scheduler windows are honored by
+step counting in ``step()``.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+
+import jax
+
+from .mfu import MFUMeter, transformer_train_flops, peak_flops_per_chip  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "MFUMeter", "transformer_train_flops", "peak_flops_per_chip",
+]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Returns a callable mapping step number → ProfilerState (paddle
+    parity; window boundaries drive trace start/stop)."""
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Returns an on_trace_ready callback storing traces under dir_name
+    (jax writes TensorBoard/XPlane format; pass the same dir to
+    TensorBoard's profile plugin)."""
+
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+def load_profiler_result(path):
+    raise NotImplementedError(
+        "load via TensorBoard's profile plugin (XPlane format)"
+    )
+
+
+class RecordEvent:
+    """Context manager annotating a host region; shows up on the XLA
+    trace timeline (reference: paddle.profiler.RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self._name = name
+        self._ann = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self._name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """paddle.profiler.Profiler parity on jax.profiler.
+
+    Usage (paddle idiom)::
+
+        p = Profiler(targets=[ProfilerTarget.TPU], scheduler=(2, 5))
+        p.start()
+        for it, batch in enumerate(loader):
+            train_step(batch)
+            p.step()
+        p.stop()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, log_dir=None):
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=start, ready=0, record=end - start, repeat=1)
+        elif scheduler is None:
+            self._scheduler = None  # trace from start() to stop()
+        else:
+            self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._export_dir = log_dir or os.environ.get(
+            "PADDLE_PROFILER_LOG_DIR", "/tmp/paddle_tpu_profile")
+        if on_trace_ready is not None:
+            on_trace_ready(self)
+        self._step_no = 0
+        self._tracing = False
+        self._step_times = []
+        self._last_step_t = None
+
+    def _maybe_transition(self):
+        if self._timer_only:
+            return
+        if self._scheduler is None:
+            want = True
+        else:
+            want = self._scheduler(self._step_no) in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if want and not self._tracing:
+            jax.profiler.start_trace(self._export_dir)
+            self._tracing = True
+        elif not want and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def start(self):
+        self._last_step_t = time.perf_counter()
+        self._maybe_transition()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step_no += 1
+        self._maybe_transition()
+
+    def stop(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def step_times(self):
+        return list(self._step_times)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        times = self._step_times or [0.0]
+        avg = sum(times) / len(times)
+        return (f"steps: {len(times)}  avg: {avg * 1e3:.2f} ms  "
+                f"min: {min(times) * 1e3:.2f} ms  max: {max(times) * 1e3:.2f} ms")

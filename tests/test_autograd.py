@@ -54,12 +54,14 @@ def test_no_grad_decorator():
     assert out.stop_gradient
 
 
-def test_backward_nonscalar_needs_grad():
+def test_backward_nonscalar_defaults_to_ones():
+    # paddle fills grad_tensor=None with ones for any root shape
     a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
-    with pytest.raises(RuntimeError):
-        (a * 2).backward()
-    (a * 2).backward(paddle.to_tensor([1.0, 1.0]))
+    (a * 2).backward()
     np.testing.assert_allclose(a.grad.numpy(), [2, 2])
+    a.clear_grad()
+    (a * 2).backward(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(a.grad.numpy(), [2, 6])
 
 
 def test_grad_api():

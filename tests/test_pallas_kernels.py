@@ -1,0 +1,211 @@
+"""Pallas kernel tests — run in interpret mode on the CPU suite, and as
+real Mosaic kernels when the backend is TPU.
+
+Covers the round-1 advisor findings: multi-head lowering legality,
+bottom-right causal alignment (seq_q != seq_k), GQA, ragged lengths, and
+that the functional dispatch actually selects the Pallas path.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+from paddle_tpu.ops.pallas.rms_norm import rms_norm
+from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+ATOL = 2e-5 if jax.default_backend() != "tpu" else 3e-2
+GTOL = 2e-4 if jax.default_backend() != "tpu" else 3e-2
+
+
+def ref_attn(q, k, v, causal):
+    qf, kf, vf = [a.astype(jnp.float32) for a in (q, k, v)]
+    h, hk = q.shape[2], k.shape[2]
+    if h != hk:
+        kf = jnp.repeat(kf, h // hk, axis=2)
+        vf = jnp.repeat(vf, h // hk, axis=2)
+    sc = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vf).astype(q.dtype)
+
+
+@pytest.mark.parametrize(
+    "sq,sk,h,hk,causal",
+    [
+        (128, 128, 2, 2, False),
+        (128, 128, 2, 2, True),
+        (100, 100, 2, 2, True),    # ragged → internal padding
+        (64, 128, 2, 1, True),     # cross-len causal (bottom-right) + MQA
+        (96, 200, 4, 2, False),    # ragged + GQA
+        (256, 256, 4, 4, True),    # multi-block
+    ],
+)
+def test_flash_attention_fwd_bwd(sq, sk, h, hk, causal):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, sq, h, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(2, sk, hk, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(2, sk, hk, 64), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = ref_attn(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=ATOL)
+
+    t = jnp.asarray(rng.randn(2, sq, h, 64), jnp.float32) * 0.1
+    ga = jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=causal) * t),
+                  (0, 1, 2))(q, k, v)
+    gb = jax.grad(lambda q, k, v: jnp.sum(ref_attn(q, k, v, causal) * t),
+                  (0, 1, 2))(q, k, v)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=GTOL)
+
+
+def test_flash_attention_bottom_right_causal_matches_xla_fallback():
+    """ADVICE r1: kernel was top-left aligned while the XLA fallback is
+    bottom-right; they must agree when seq_q != seq_k."""
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 8, 2, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 128, 2, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 128, 2, 64), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = ref_attn(q, k, v, True)  # tril(k=sk-sq) — bottom-right
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=ATOL)
+
+
+def test_flash_attention_rejects_bad_heads():
+    q = jnp.zeros((1, 16, 3, 64))
+    k = jnp.zeros((1, 16, 2, 64))
+    with pytest.raises(ValueError):
+        flash_attention(q, k, k)
+
+
+@pytest.mark.parametrize("shape", [(4, 128, 512), (3, 100, 256), (7, 64)])
+def test_rms_norm_fwd_bwd(shape):
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    w = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+
+    def ref(x, w, eps=1e-6):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)) * w
+
+    np.testing.assert_allclose(
+        np.asarray(rms_norm(x, w)), np.asarray(ref(x, w)), atol=ATOL
+    )
+    t = jnp.asarray(rng.randn(*shape), jnp.float32)
+    ga = jax.grad(lambda x, w: jnp.sum(rms_norm(x, w) * t), (0, 1))(x, w)
+    gb = jax.grad(lambda x, w: jnp.sum(ref(x, w) * t), (0, 1))(x, w)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=GTOL)
+
+
+def test_rms_norm_bf16():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 256), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(256), jnp.bfloat16)
+    out = rms_norm(x, w)
+    assert out.dtype == jnp.bfloat16
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    ref = (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6))
+    ref = (ref.astype(jnp.bfloat16) * w).astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.1
+    )
+
+
+@pytest.mark.parametrize("b,h,hk,smax", [(2, 4, 4, 256), (2, 8, 2, 300)])
+def test_decode_attention(b, h, hk, smax):
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(b, h, 64), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, smax, hk, 64), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, smax, hk, 64), jnp.float32)
+    lens = jnp.asarray(rng.randint(1, smax, size=(b,)), jnp.int32)
+    out = decode_attention(q, kc, vc, lens)
+
+    sc = 1 / math.sqrt(64)
+    kr = jnp.repeat(kc, h // hk, axis=2)
+    vr = jnp.repeat(vc, h // hk, axis=2)
+    logits = jnp.einsum("bhd,bshd->bhs", q, kr) * sc
+    mask = jnp.arange(smax)[None, None, :] < lens[:, None, None]
+    p = jax.nn.softmax(jnp.where(mask, logits, -jnp.inf), axis=-1)
+    ref = jnp.einsum("bhs,bshd->bhd", p, vr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=ATOL)
+
+
+def test_decode_attention_4d_query():
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 1, 4, 64), jnp.float32)
+    kc = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    vc = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    lens = jnp.asarray([7, 128], jnp.int32)
+    out = decode_attention(q, kc, vc, lens)
+    assert out.shape == (2, 1, 4, 64)
+
+
+def test_dispatch_selects_pallas_path(monkeypatch):
+    """The functional surface must actually route to the kernel when the
+    gate is open (round-1: silent fallback hid a broken kernel)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional.attention as attn_mod
+
+    calls = {}
+    real = attn_mod._pallas_flash
+
+    def spy(q, k, v, causal=False):
+        calls["hit"] = True
+        return real(q, k, v, causal=causal)
+
+    monkeypatch.setattr(attn_mod, "_pallas_flash", spy)
+    paddle.set_flags({"FLAGS_pallas_force": True})
+    try:
+        q = paddle.to_tensor(np.random.randn(1, 128, 2, 64).astype("float32"))
+        out = attn_mod.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert calls.get("hit"), "Pallas path was not selected"
+        assert out.shape == [1, 128, 2, 64]
+    finally:
+        paddle.set_flags({"FLAGS_pallas_force": False})
+
+
+def test_rms_norm_dispatch_selects_pallas(monkeypatch):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.nn.functional.norm as norm_mod
+
+    calls = {}
+    real = norm_mod._pallas_rms_norm
+
+    def spy(v, w, eps):
+        calls["hit"] = True
+        return real(v, w, eps)
+
+    monkeypatch.setattr(norm_mod, "_pallas_rms_norm", spy)
+    paddle.set_flags({"FLAGS_pallas_force": True})
+    try:
+        x = paddle.to_tensor(np.random.randn(4, 256).astype("float32"))
+        w = paddle.to_tensor(np.ones(256, "float32"))
+        out = F.rms_norm(x, w)
+        assert calls.get("hit"), "Pallas rms_norm path was not selected"
+        ref = np.asarray(x.numpy()) / np.sqrt(
+            np.mean(np.square(x.numpy()), -1, keepdims=True) + 1e-6
+        )
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+    finally:
+        paddle.set_flags({"FLAGS_pallas_force": False})
+
+
+def test_rms_norm_begin_norm_axis():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.random.randn(2, 3, 4).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    out = F.rms_norm(x, w, begin_norm_axis=1)
+    xn = x.numpy()
+    var = np.mean(np.square(xn.reshape(2, -1)), -1, keepdims=True)
+    ref = (xn.reshape(2, -1) / np.sqrt(var + 1e-6)).reshape(2, 3, 4) * w.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
