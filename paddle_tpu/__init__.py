@@ -100,3 +100,7 @@ from .hapi.model import Model  # noqa: E402
 from . import hapi  # noqa: E402
 from . import callbacks  # noqa: E402
 from .hapi.summary import summary, flops  # noqa: E402
+from . import incubate  # noqa: E402
+from . import inference  # noqa: E402
+from . import nlp  # noqa: E402
+from . import profiler  # noqa: E402

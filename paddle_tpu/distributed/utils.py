@@ -1,11 +1,40 @@
-"""paddle.distributed.utils shims."""
+"""paddle.distributed.utils shims (reference:
+paddle/fluid/operators/collective/global_scatter_op.* — unverified,
+SURVEY.md §0/§2.3 EP row).
+
+``global_scatter``/``global_gather`` are the reference's NCCL alltoallv
+ops for MoE token exchange. The TPU-native MoE
+(paddle_tpu.incubate.distributed.models.moe.MoELayer) does NOT use them —
+its dispatch/combine einsums let GSPMD emit the all-to-all. These
+functions exist for API parity only: in the single-controller GSPMD
+model every process sees the global token tensor, so the only faithful
+case is the identity exchange (local_count == global_count); an actual
+asymmetric alltoallv has no single-controller representation and raises.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor._helpers import ensure_tensor
+
+
 def get_gpus(selected_gpus):
     return []
 
 
-def global_scatter(*a, **k):
-    raise NotImplementedError("MoE global_scatter lands with the EP module")
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    x = ensure_tensor(x)
+    lc = np.asarray(ensure_tensor(local_count).numpy())
+    gc = np.asarray(ensure_tensor(global_count).numpy())
+    if lc.shape == gc.shape and (lc == gc).all():
+        return x  # identity exchange — the only single-controller case
+    raise ValueError(
+        "global_scatter with local_count != global_count is an alltoallv "
+        "between processes; under the single-controller GSPMD runtime use "
+        "paddle_tpu.incubate.distributed.models.moe.MoELayer, whose "
+        "dispatch/combine einsums compile to the same all-to-all."
+    )
 
 
-def global_gather(*a, **k):
-    raise NotImplementedError("MoE global_gather lands with the EP module")
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    return global_scatter(x, global_count, local_count, group, use_calc_stream)

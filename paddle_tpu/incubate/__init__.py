@@ -1,0 +1,7 @@
+"""paddle.incubate namespace (reference: python/paddle/incubate/ —
+unverified, SURVEY.md §0/§2.4): fused-op wrappers and experimental
+distributed features, TPU-native."""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+
+__all__ = ["nn", "distributed"]

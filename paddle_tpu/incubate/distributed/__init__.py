@@ -1,0 +1,6 @@
+"""paddle.incubate.distributed — experimental distributed features
+(reference: python/paddle/incubate/distributed/ — unverified,
+SURVEY.md §0). MoE lives in .models.moe."""
+from . import models  # noqa: F401
+
+__all__ = ["models"]

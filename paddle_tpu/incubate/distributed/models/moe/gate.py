@@ -1,0 +1,83 @@
+"""MoE gates (reference: .../moe/gate/{gshard,switch,naive}_gate.py —
+unverified, SURVEY.md §0).
+
+A gate maps token activations (T, E_model) → routing decisions. The
+capacity-based formulation returns dense one-hot dispatch/combine masks
+(T, num_experts, capacity) that downstream einsums consume; the
+load-balancing auxiliary loss (GShard eq. 4) is stored on the gate.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TopKGate", "GShardGate", "SwitchGate"]
+
+
+def _capacity(num_tokens, num_experts, capacity_factor, top_k):
+    cap = int(num_tokens * top_k * capacity_factor / num_experts)
+    return max(cap, top_k)
+
+
+def _one_hot_dispatch(gates, top_k, capacity):
+    """gates (T, E) softmax probs → (dispatch (T,E,C) bool, combine
+    (T,E,C) float, aux_loss scalar)."""
+    t, e = gates.shape
+    # straight GShard: iterate the k choices, masking prior picks
+    dispatch = jnp.zeros((t, e, capacity), jnp.bool_)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    masked = gates
+    me = jnp.mean(gates, axis=0)          # mean prob per expert
+    ce_counts = jnp.zeros((e,), jnp.float32)
+    # position counters per expert, threaded across the k rounds
+    pos_base = jnp.zeros((e,), jnp.int32)
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=1)                       # (T,)
+        sel = jax.nn.one_hot(idx, e, dtype=jnp.float32)        # (T, E)
+        ce_counts = ce_counts + jnp.sum(sel, axis=0)
+        # position of each token within its expert's queue this round
+        pos_in = jnp.cumsum(sel, axis=0) - sel                 # (T, E)
+        pos = (pos_in + pos_base[None, :]).astype(jnp.int32)
+        within = pos < capacity
+        keep = (sel > 0) & within                              # (T, E)
+        posc = jax.nn.one_hot(
+            jnp.sum(pos * sel.astype(jnp.int32), axis=1), capacity,
+            dtype=jnp.float32)                                 # (T, C)
+        disp_k = keep[:, :, None] & (posc[:, None, :] > 0)
+        dispatch = dispatch | disp_k
+        gate_val = jnp.sum(gates * sel, axis=1)                # (T,)
+        combine = combine + disp_k.astype(jnp.float32) * gate_val[:, None, None]
+        pos_base = pos_base + jnp.sum(keep, axis=0).astype(jnp.int32)
+        masked = jnp.where(sel > 0, -jnp.inf, masked)
+    # GShard aux loss: E * mean(fraction_routed * mean_prob)
+    fraction = ce_counts / jnp.maximum(jnp.sum(ce_counts), 1.0)
+    aux = jnp.sum(fraction * me) * e
+    return dispatch, combine, aux
+
+
+class TopKGate:
+    """Dense top-k capacity gate over a learned projection."""
+
+    def __init__(self, top_k=2, capacity_factor=1.25):
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.l_aux = None
+
+    def __call__(self, logits):
+        """logits (T, E) → (dispatch, combine, capacity)."""
+        t, e = logits.shape
+        cap = _capacity(t, e, self.capacity_factor, self.top_k)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        dispatch, combine, aux = _one_hot_dispatch(gates, self.top_k, cap)
+        self.l_aux = aux
+        return dispatch, combine, cap
+
+
+class GShardGate(TopKGate):
+    def __init__(self, capacity_factor=2.0):
+        super().__init__(top_k=2, capacity_factor=capacity_factor)
+
+
+class SwitchGate(TopKGate):
+    def __init__(self, capacity_factor=1.25):
+        super().__init__(top_k=1, capacity_factor=capacity_factor)

@@ -1,0 +1,117 @@
+"""MoELayer — expert-parallel FFN mixture (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py — unverified,
+SURVEY.md §0/§2.3 EP row).
+
+Experts are a stacked SwiGLU/GELU FFN: weights (num_experts, ...) sharded
+over an ``expert`` mesh axis. Dispatch/combine are the GShard einsums —
+under a mesh, constraining the dispatched tensor's expert dim makes GSPMD
+emit the all-to-all over ICI (the reference's GlobalScatter/GlobalGather
+NCCL ops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer.layers import Layer
+from .....nn import initializer as I
+from .....tensor._helpers import apply, ensure_tensor
+from .....parallel import mesh as mesh_state
+from .gate import TopKGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(Layer):
+    """MoE FFN block.
+
+    Args:
+        d_model: token dim.
+        d_hidden: expert FFN hidden dim.
+        num_experts: global expert count.
+        gate: "gshard" | "switch" | a gate object (default top-2).
+        activation: "gelu" | "swiglu".
+        expert_axis: mesh axis experts shard over (default: "dp" when its
+            size divides num_experts, else "mp"; no mesh → serial).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 activation="gelu", capacity_factor=2.0, expert_axis=None,
+                 name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.activation = activation
+        if isinstance(gate, str):
+            gate = {"gshard": TopKGate(2, capacity_factor),
+                    "switch": SwitchGate(capacity_factor),
+                    "top2": TopKGate(2, capacity_factor)}[gate]
+        self.gate = gate
+        self.l_aux = None
+
+        ffn1_out = 2 * d_hidden if activation == "swiglu" else d_hidden
+        self.gate_weight = self.create_parameter(
+            (d_model, num_experts), default_initializer=I.XavierNormal())
+        self.w1 = self.create_parameter(
+            (num_experts, d_model, ffn1_out),
+            default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter((num_experts, ffn1_out), is_bias=True)
+        self.w2 = self.create_parameter(
+            (num_experts, d_hidden, d_model),
+            default_initializer=I.XavierNormal())
+        self.b2 = self.create_parameter((num_experts, d_model), is_bias=True)
+
+        axis = expert_axis
+        if axis is None and mesh_state.has_mesh():
+            for cand in ("dp", "mp"):
+                if (mesh_state.mesh_axis_size(cand) > 1
+                        and num_experts % mesh_state.mesh_axis_size(cand) == 0):
+                    axis = cand
+                    break
+        self.expert_axis = axis
+        if axis is not None:
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                p.is_distributed = True
+                spec = [axis] + [None] * (p._value.ndim - 1)
+                p._value = mesh_state.shard_value(p._value, *spec)
+
+    def forward(self, x):
+        """x: (..., d_model) → same shape; self.l_aux holds the aux loss."""
+        x = ensure_tensor(x)
+        gate = self.gate
+        cfg = self
+
+        def fn(xv, gw, w1, b1, w2, b2):
+            lead = xv.shape[:-1]
+            t = 1
+            for s in lead:
+                t *= s
+            xt = xv.reshape(t, cfg.d_model)
+            logits = xt.astype(jnp.float32) @ gw.astype(jnp.float32)
+            dispatch, combine, cap = gate(logits)
+            aux = gate.l_aux
+            # dispatch: (T, E, C) → expert inputs (E, C, M)
+            disp = jnp.einsum(
+                "tec,tm->ecm", dispatch.astype(xv.dtype), xt)
+            if cfg.expert_axis is not None:
+                disp = mesh_state.constraint(disp, cfg.expert_axis, None, None)
+            h = jnp.einsum("ecm,emh->ech", disp, w1.astype(xv.dtype))
+            h = h + b1[:, None, :].astype(xv.dtype)
+            if cfg.activation == "swiglu":
+                g_, u_ = jnp.split(h, 2, axis=-1)
+                h = jax.nn.silu(g_.astype(jnp.float32)).astype(u_.dtype) * u_
+            else:
+                h = jax.nn.gelu(h.astype(jnp.float32)).astype(h.dtype)
+            out = jnp.einsum("ech,ehm->ecm", h, w2.astype(xv.dtype))
+            out = out + b2[:, None, :].astype(xv.dtype)
+            if cfg.expert_axis is not None:
+                out = mesh_state.constraint(out, cfg.expert_axis, None, None)
+            y = jnp.einsum("tec,ecm->tm", combine.astype(xv.dtype), out)
+            # aux returned through the op so the load-balancing loss stays
+            # on the tape (differentiable into gate_weight)
+            return y.reshape(*lead, cfg.d_model), aux
+
+        out, self.l_aux = apply(
+            fn, x, self.gate_weight, self.w1, self.b1, self.w2,
+            self.b2, op_name="moe_layer")
+        return out

@@ -1,0 +1,15 @@
+"""paddle.incubate.nn — fused layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py and
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu — unverified,
+SURVEY.md §0/§2.5).
+
+``FusedMultiTransformer`` is the decode-path flagship: the WHOLE decoder
+stack runs as one XLA program — per-layer weights are stacked with a
+leading layer dim and the stack is a ``lax.scan``, so a 32-layer decode
+step is a single dispatch (the reference gets this with one mega CUDA op;
+XLA gets it with scan + the Pallas decode-attention kernel).
+"""
+from .fused_transformer import FusedMultiTransformer  # noqa: F401
+from . import functional  # noqa: F401
+
+__all__ = ["FusedMultiTransformer", "functional"]

@@ -1,0 +1,130 @@
+"""paddle.incubate.nn.functional — fused-op functional wrappers.
+
+On TPU most of the reference's CUDA fusions are XLA fusions; the ones
+with real kernels here are rope (elementwise, XLA-fused), rms_norm and
+flash attention (Pallas). The API shapes mirror the reference wrappers.
+"""
+from __future__ import annotations
+
+from ....nn.functional.rope import (  # noqa: F401
+    fused_rotary_position_embedding,
+)
+from ....nn import functional as _F
+from ....tensor._helpers import ensure_tensor
+
+__all__ = [
+    "fused_rotary_position_embedding", "fused_rms_norm", "fused_layer_norm",
+    "fused_linear", "fused_bias_act", "fused_multi_head_attention",
+    "fused_feedforward",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **quant_kwargs):
+    """Reference: fused_bias_residual_layernorm / rms_norm fusion
+    (SURVEY.md §2.5). Returns (out, residual_out) like the reference when
+    a residual is supplied, else out."""
+    if bias is not None:
+        x = x + bias
+    residual_out = None
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    out = _F.rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    if bias is not None:
+        x = x + bias
+    residual_out = None
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    shape = ensure_tensor(x).shape[begin_norm_axis:] if begin_norm_axis != -1 \
+        else [ensure_tensor(x).shape[-1]]
+    out = _F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """cublasLt epilogue analog — XLA fuses dot+bias natively."""
+    if transpose_weight:
+        weight = ensure_tensor(weight).T
+    return _F.linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    act = getattr(_F, act_method)
+    return act(x)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, name=None):
+    """Training-time fused MHA block (reference: fused_attention_op.cu —
+    SURVEY.md §2.5); composed here from flash attention + XLA epilogues."""
+    x = ensure_tensor(x)
+    b, s, e = x.shape
+    residual = x
+    if pre_layer_norm:
+        x = _F.layer_norm(x, [e], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkv_w = ensure_tensor(qkv_weight)  # (3, H, D, E) paddle layout
+    three, h, d, _ = qkv_w.shape
+    qkv = _F.linear(x, qkv_w.reshape([3 * h * d, e]).T,
+                    None if qkv_bias is None
+                    else ensure_tensor(qkv_bias).reshape([3 * h * d]))
+    qkv = qkv.reshape([b, s, 3, h, d])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = _F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    attn = attn.reshape([b, s, h * d])
+    out = _F.linear(attn, linear_weight, linear_bias)
+    if dropout_rate:
+        out = _F.dropout(out, p=dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _F.layer_norm(out, [e], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, add_residual=True, name=None):
+    """Reference: fused_feedforward_op.cu (SURVEY.md §2.5)."""
+    x = ensure_tensor(x)
+    e = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = _F.layer_norm(x, [e], ln1_scale, ln1_bias, ln1_epsilon)
+    act = getattr(_F, activation)
+    h = act(_F.linear(x, linear1_weight, linear1_bias))
+    if dropout1_rate:
+        h = _F.dropout(h, p=dropout1_rate, training=training)
+    out = _F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        out = _F.dropout(out, p=dropout2_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _F.layer_norm(out, [e], ln2_scale, ln2_bias, ln2_epsilon)
+    return out

@@ -1,0 +1,139 @@
+"""paddle.inference — the Predictor serving facade (reference:
+paddle/fluid/inference/api/analysis_predictor.cc, python surface
+python/paddle/inference/ — unverified, SURVEY.md §0/§2.6).
+
+The reference's AnalysisPredictor loads a program, runs IR fusion passes,
+and serves via ZeroCopy tensors; on TPU the "analysis" is XLA compilation
+of the jax.export artifact written by ``paddle.jit.save``, and zero-copy
+handles are thin views over device arrays. TensorRT-style subgraphing has
+no analog — XLA is the engine.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PrecisionType", "PlaceType",
+]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class Config:
+    """paddle.inference.Config parity (the knobs that matter here:
+    model path prefix; everything GPU/TRT/MKLDNN is accepted and ignored
+    with a record in ``ignored_options``)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle accepts Config(prefix) or Config(model_file, params_file)
+        self._prefix = None
+        if prog_file is not None:
+            p = str(prog_file)
+            self._prefix = p[:-8] if p.endswith(".pdmodel") else p
+        self.ignored_options = []
+
+    def set_prog_file(self, path):
+        p = str(path)
+        self._prefix = p[:-8] if p.endswith(".pdmodel") else p
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def __getattr__(self, name):
+        # accept-and-record every enable_*/set_*/switch_* tuning knob
+        if name.startswith(("enable_", "set_", "switch_", "disable_")):
+            def sink(*a, **k):
+                self.ignored_options.append(name)
+            return sink
+        raise AttributeError(name)
+
+
+class _Handle:
+    """Zero-copy tensor handle."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load
+
+        self._layer = load(config._prefix)
+        n_in = self._n_inputs()
+        self._inputs = {f"input_{i}": _Handle() for i in range(n_in)}
+        self._outputs = {}
+
+    def _n_inputs(self):
+        ex = self._layer._exported
+        try:
+            return len(ex.in_avals) - len(self._layer._params)
+        except Exception:
+            return 1
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """paddle_infer::Predictor::Run. With ``inputs`` (list of arrays)
+        returns outputs directly; else consumes the input handles."""
+        if inputs is not None:
+            vals = list(inputs)
+        else:
+            vals = [h._value for h in self._inputs.values()]
+        out = self._layer(*vals)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            h = _Handle()
+            h._value = o._value if hasattr(o, "_value") else o
+            self._outputs[f"output_{i}"] = h
+        if inputs is not None:
+            return [np.asarray(h._value) for h in self._outputs.values()]
+        return True
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def clone(self):
+        import copy
+
+        return copy.copy(self)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,203 @@
+"""Text generation — the decode serving path (BASELINE.md config #5
+class of workloads; reference: fused_multi_transformer decode HOT LOOP,
+SURVEY.md §3.5).
+
+Two modes:
+- ``generate``: host loop, one jitted step per token (debuggable).
+- ``generate_on_device``: the ENTIRE decode loop inside one XLA program
+  (``lax.while_loop`` over a jitted single-token step with static cache
+  shapes) — one dispatch per sequence, the idiomatic TPU serving shape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..jit import functional_call
+
+__all__ = ["greedy_search", "generate_on_device"]
+
+
+def _logits_fn(model, p_vals, ids, offset_val, kc, vc):
+    """Pure fn: one forward over ids with stacked caches (L,B,S,HK,D)."""
+    caches = [(Tensor(kc[i], stop_gradient=True),
+               Tensor(vc[i], stop_gradient=True))
+              for i in range(kc.shape[0])]
+    with autograd.no_grad():
+        def fwd(ids_t):
+            logits, new_caches = model(ids_t, position_offset=offset_val,
+                                       caches=caches)
+            return logits, new_caches
+
+        (logits, new_caches), _ = functional_call(
+            model, fwd, [Tensor(ids, stop_gradient=True)], {}, p_vals, [])
+    new_kc = jnp.stack([c[0]._value for c in new_caches])
+    new_vc = jnp.stack([c[1]._value for c in new_caches])
+    return logits._value, new_kc, new_vc
+
+
+def greedy_search(model, input_ids, max_new_tokens=32, max_length=None,
+                  eos_token_id=None):
+    """Host-driven greedy decode on a LlamaForCausalLM-shaped model.
+    Returns (B, S_in + max_new_tokens) token ids."""
+    import paddle_tpu as paddle
+
+    input_ids = input_ids if isinstance(input_ids, Tensor) else paddle.to_tensor(input_ids)
+    b, s_in = input_ids.shape
+    total = max_length or (s_in + max_new_tokens)
+    cfg = model.config
+    p_vals = [p._value for _, p in model.named_parameters()]
+
+    kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+                    cfg.num_key_value_heads, cfg.head_dim), jnp.float32)
+    vc = jnp.zeros_like(kc)
+
+    prefill = jax.jit(
+        lambda pv, ids, kc, vc: _logits_fn(model, pv, ids, 0, kc, vc))
+    logits, kc, vc = prefill(p_vals, input_ids._value, kc, vc)
+    next_tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+
+    # decode steps share one compiled fn (offset passed as static int per
+    # position would retrace; instead dynamic offset via closure trick:
+    # re-jit per offset is avoided by using a dynamic slice update inside)
+    step = jax.jit(
+        lambda pv, tok, off, kc, vc: _decode_step(model, pv, tok, off, kc, vc))
+
+    out = [input_ids._value, next_tok]
+    pos = s_in
+    while pos + 1 < total:
+        logits, kc, vc = step(p_vals, next_tok, jnp.int32(pos), kc, vc)
+        next_tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+        out.append(next_tok)
+        pos += 1
+        if eos_token_id is not None and bool(jnp.all(next_tok == eos_token_id)):
+            break
+    return paddle.to_tensor(jnp.concatenate(out, axis=1))
+
+
+def _decode_step(model, p_vals, tok, offset, kc, vc):
+    """One-token decode with a TRACED offset: rebuilds the per-layer cache
+    update with lax.dynamic_update_slice (model._update_cache uses the
+    same primitive, but its position_offset must be traced here)."""
+    cfg = model.config
+    b = tok.shape[0]
+
+    # run the decoder manually over stacked caches to keep offset traced
+    with autograd.no_grad():
+        def fwd(ids_t):
+            return _manual_decode(model, ids_t, offset, kc, vc)
+
+        (logits, new_kc, new_vc), _ = functional_call(
+            model, fwd, [Tensor(tok, stop_gradient=True)], {}, p_vals, [])
+    return logits, new_kc, new_vc
+
+
+def _manual_decode(model, ids_t, offset, kc, vc):
+    """Decode forward with traced position offset over stacked caches."""
+    from ..nn.functional.rope import build_rope_cache, apply_rotary_emb
+    import paddle_tpu as paddle
+
+    cfg = model.config
+    core = model.llama
+    hidden = core.embed_tokens(ids_t)
+    b, s, _ = hidden.shape
+    h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                cfg.head_dim)
+
+    inv_freq = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = offset.astype(jnp.float32) + jnp.arange(s, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv_freq)
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+
+    new_kcs, new_vcs = [], []
+    for i, layer in enumerate(core.layers):
+        attn = layer.self_attn
+        residual = hidden
+        x = layer.input_layernorm(hidden)
+        q = attn.q_proj(x).reshape([b, s, h, d])
+        k = attn.k_proj(x).reshape([b, s, hk, d])
+        v = attn.v_proj(x).reshape([b, s, hk, d])
+        qv = apply_rotary_emb(q._value, cos, sin)
+        kv = apply_rotary_emb(k._value, cos, sin)
+
+        kci = jax.lax.dynamic_update_slice(
+            kc[i], kv.astype(kc.dtype)[:, :],
+            (0, offset.astype(jnp.int32), 0, 0))
+        vci = jax.lax.dynamic_update_slice(
+            vc[i], v._value.astype(vc.dtype),
+            (0, offset.astype(jnp.int32), 0, 0))
+        new_kcs.append(kci)
+        new_vcs.append(vci)
+
+        lens = jnp.full((b,), offset + s, jnp.int32)
+        if jax.default_backend() == "tpu":
+            from ..ops.pallas.decode_attention import decode_attention
+
+            att = decode_attention(qv[:, 0], kci, vci, lens)[:, None]
+        else:
+            from ..incubate.nn.fused_transformer import _masked_decode_attn
+
+            att = _masked_decode_attn(qv, kci, vci, lens)
+        att_t = Tensor(att.reshape(b, s, h * d), stop_gradient=True)
+        hidden = residual + attn.o_proj(att_t)
+        hidden = hidden + layer.mlp(layer.post_attention_layernorm(hidden))
+    hidden = core.norm(hidden)
+    logits = model.lm_head(hidden)
+    return logits._value, jnp.stack(new_kcs), jnp.stack(new_vcs)
+
+
+def generate_on_device(model, input_ids, max_new_tokens=32):
+    """Whole greedy decode in ONE dispatch: prefill + ``lax.scan`` of
+    single-token steps (static trip count), all inside one jitted
+    program. Caches match the model's param dtype."""
+    import paddle_tpu as paddle
+
+    input_ids = input_ids if isinstance(input_ids, Tensor) else paddle.to_tensor(input_ids)
+    b, s_in = input_ids.shape
+    total = s_in + max_new_tokens
+    cfg = model.config
+    p_vals = [p._value for _, p in model.named_parameters()]
+    cache_dtype = p_vals[0].dtype
+
+    # cache the compiled program on the model (a fresh closure per call
+    # would recompile every time)
+    jit_cache = getattr(model, "_generate_jit_cache", None)
+    if jit_cache is None:
+        jit_cache = model._generate_jit_cache = {}
+    cache_key = (b, s_in, max_new_tokens, str(cache_dtype))
+    if cache_key in jit_cache:
+        tokens = jit_cache[cache_key](p_vals, input_ids._value)
+        return paddle.to_tensor(tokens)
+
+    def full(pv, ids):
+        kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+                        cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
+        vc = jnp.zeros_like(kc)
+        logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+
+        def body(carry, _):
+            pos, tok, kc, vc = carry
+            with autograd.no_grad():
+                def fwd(t_):
+                    return _manual_decode(model, t_, pos, kc, vc)
+
+                (logits, kc2, vc2), _ = functional_call(
+                    model, fwd, [Tensor(tok, stop_gradient=True)], {}, pv, [])
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+            return (pos + 1, nxt, kc2, vc2), tok[:, 0]
+
+        (_, last, _, _), toks = jax.lax.scan(
+            body, (jnp.int32(s_in), first, kc, vc), None,
+            length=max_new_tokens - 1)
+        # toks: (K-1, B) tokens at positions s_in .. total-2; append last
+        gen = jnp.concatenate([toks.T, last], axis=1)
+        return jnp.concatenate([ids.astype(jnp.int32), gen], axis=1)
+
+    jitted = jax.jit(full)
+    jit_cache[cache_key] = jitted
+    tokens = jitted(p_vals, input_ids._value)
+    return paddle.to_tensor(tokens)

@@ -1,0 +1,60 @@
+"""Secondary benchmark: decode tokens/sec through the on-device greedy
+loop (KV cache + Pallas decode kernel + lm head, whole loop one dispatch).
+
+Not the driver headline (bench.py prints that); run manually:
+    python scripts/bench_decode.py
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import generate_on_device
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            max_position_embeddings=4096, tensor_parallel=False)
+        batch, s_in, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, s_in, new = 2, 8, 8
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    if on_tpu:
+        m.astype("bfloat16")
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, s_in)))
+
+    t0 = time.perf_counter()
+    out = generate_on_device(m, ids, max_new_tokens=new)
+    _ = out.numpy()
+    compile_t = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = generate_on_device(m, ids, max_new_tokens=new)
+    _ = out.numpy()
+    run_t = time.perf_counter() - t0
+
+    toks = batch * new
+    print(f"compile {compile_t:.1f}s run {run_t:.3f}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "llama_375m_decode_tokens_per_sec",
+        "value": round(toks / run_t, 1),
+        "unit": "tokens/s",
+        "batch": batch, "new_tokens": new,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""incubate fused layers, MoE, generation, and the Predictor facade."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.parallel import mesh as mesh_state
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    mesh_state.set_mesh(None)
+
+
+def test_fused_multi_transformer_decode_matches_full():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    fmt = FusedMultiTransformer(
+        64, 4, 128, num_layers=3, norm_type="rmsnorm", activation="swiglu",
+        num_key_value_heads=2)
+    fmt.eval()
+    x = paddle.randn([2, 8, 64])
+    caches = fmt.gen_cache(2, 32)
+    _, caches = fmt(x, caches=caches, time_step=0)
+    nxt = paddle.randn([2, 1, 64])
+    out_dec, caches = fmt(nxt, caches=caches, time_step=8)
+    out_full = fmt(paddle.concat([x, nxt], axis=1))
+    np.testing.assert_allclose(
+        out_dec.numpy()[:, 0], out_full.numpy()[:, -1], atol=1e-4)
+
+
+def test_fused_multi_transformer_gelu_layernorm():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    fmt = FusedMultiTransformer(32, 2, 64, num_layers=2)
+    out = fmt(paddle.randn([2, 4, 32]))
+    assert out.shape == [2, 4, 32]
+
+
+def test_fused_functional_wrappers():
+    from paddle_tpu.incubate.nn import functional as IF
+
+    x = paddle.randn([2, 4, 8])
+    w = paddle.ones([8])
+    out = IF.fused_rms_norm(x, w)
+    ref = F.rms_norm(x, w)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-6)
+    out2, res = IF.fused_rms_norm(x, w, residual=paddle.zeros([2, 4, 8]))
+    np.testing.assert_allclose(out2.numpy(), ref.numpy(), atol=1e-6)
+
+    q, k, v = (paddle.randn([2, 6, 2, 32]) for _ in range(3))
+    rq, rk, rv = IF.fused_rotary_position_embedding(q, k, v)
+    assert rq.shape == q.shape and rk.shape == k.shape
+
+
+def test_moe_layer_forward_backward():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(1)
+    moe = MoELayer(16, 32, num_experts=4, gate="gshard")
+    x = paddle.randn([4, 8, 16])
+    x.stop_gradient = False
+    y = moe(x)
+    assert y.shape == [4, 8, 16]
+    loss = (y * y).mean() + 0.01 * moe.l_aux
+    loss.backward()
+    assert float(paddle.abs(moe.gate_weight.grad).sum()) > 0
+    assert float(paddle.abs(moe.w1.grad).sum()) > 0
+
+
+def test_moe_capacity_drops_overflow():
+    """switch gate with tiny capacity: tokens over capacity are dropped
+    (output zero for them), never crash."""
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer, SwitchGate
+
+    paddle.seed(2)
+    moe = MoELayer(8, 16, num_experts=2, gate=SwitchGate(capacity_factor=0.5))
+    y = moe(paddle.randn([16, 8]))
+    assert y.shape == [16, 8]
+
+
+def test_moe_expert_parallel_matches_serial():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.distributed import fleet
+
+    x_np = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+
+    def run(parallel):
+        mesh_state.set_mesh(None)
+        if parallel:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {
+                "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                "sharding_degree": 1,
+            }
+            fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(3)
+        moe = MoELayer(16, 32, num_experts=4, gate="gshard",
+                       expert_axis="dp" if parallel else None)
+        y = moe(paddle.to_tensor(x_np))
+        return y.numpy(), float(moe.l_aux)
+
+    yp, auxp = run(True)
+    ys, auxs = run(False)
+    np.testing.assert_allclose(yp, ys, rtol=1e-4, atol=1e-5)
+    assert abs(auxp - auxs) < 1e-5
+
+
+def test_generation_greedy_and_on_device():
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import greedy_search, generate_on_device
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 128, (2, 8)))
+
+    cur = ids.numpy()
+    for _ in range(4):
+        logits = m(paddle.to_tensor(cur))
+        cur = np.concatenate(
+            [cur, logits.numpy()[:, -1].argmax(-1)[:, None]], axis=1)
+
+    out = greedy_search(m, ids, max_new_tokens=4)
+    assert (out.numpy() == cur).all()
+    out2 = generate_on_device(m, ids, max_new_tokens=4)
+    assert (out2.numpy() == cur).all()
+
+
+def test_predictor_roundtrip(tmp_path):
+    import paddle_tpu.inference as infer
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    net.eval()
+    path = os.path.join(str(tmp_path), "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    config = infer.Config(path)
+    config.enable_memory_optim()  # accepted + recorded, not an error
+    pred = infer.create_predictor(config)
+    names = pred.get_input_names()
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_global_scatter_facade():
+    import paddle_tpu.distributed.utils as du
+
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    lc = paddle.to_tensor(np.array([2, 4]))
+    gc = paddle.to_tensor(np.array([2, 4]))
+    out = du.global_scatter(x, lc, gc)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+    with pytest.raises(ValueError):
+        du.global_scatter(x, lc, paddle.to_tensor(np.array([4, 2])))
