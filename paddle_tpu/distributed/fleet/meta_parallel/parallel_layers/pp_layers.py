@@ -59,6 +59,10 @@ class PipelineLayer(Layer):
         if num_stages is None:
             num_stages = hcg.num_stages if hcg is not None else 1
         self._num_stages = num_stages
+        # interleaved schedule: v virtual chunks per physical stage,
+        # chunk c placed round-robin on stage c % num_stages (the
+        # reference's PipelineParallelWithInterleave placement)
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         self._descs = list(layers)
 
         # build all layers (single controller owns every stage)
@@ -81,21 +85,23 @@ class PipelineLayer(Layer):
             else:
                 raise TypeError(f"unsupported pipeline item {desc!r}")
 
-        # stage partition
-        self._segment = self._segment_layers(built, num_stages, seg_method)
+        # chunk partition (num_stages * num_virtual chunks)
+        self._segment = self._segment_layers(
+            built, num_stages * self._num_virtual, seg_method)
         self.run_function = LayerList(
             [l for l, _ in built if isinstance(l, Layer)]
         )
         self._items = built
 
-        # place each stage's params on its stage mesh; a layer shared
-        # across stages (tied embeddings) is placed once, on its FIRST
-        # owning stage — later stages reach it through the inter-stage
-        # transfer, like the reference's shared-weight broadcast group
+        # place each chunk's params on its owning stage's mesh; a layer
+        # shared across stages (tied embeddings) is placed once, on its
+        # FIRST owning stage — later stages reach it through the
+        # inter-stage transfer, like the reference's shared-weight
+        # broadcast group
         if hcg is not None and hcg.num_stages > 1:
             placed: set[int] = set()
-            for stage, (lo, hi) in enumerate(self._segment):
-                mesh = hcg.get_stage_mesh(stage)
+            for chunk, (lo, hi) in enumerate(self._segment):
+                mesh = hcg.get_stage_mesh(self.chunk_stage(chunk))
                 for item, _ in built[lo:hi]:
                     if isinstance(item, Layer) and id(item) not in placed:
                         placed.add(id(item))
@@ -135,6 +141,15 @@ class PipelineLayer(Layer):
     @property
     def num_stages(self):
         return self._num_stages
+
+    @property
+    def num_chunks(self):
+        """Total pipeline units (= num_stages * virtual factor)."""
+        return len(self._segment)
+
+    def chunk_stage(self, chunk):
+        """Physical stage owning a chunk (round-robin for interleave)."""
+        return chunk % self._num_stages
 
     @property
     def loss_fn(self):

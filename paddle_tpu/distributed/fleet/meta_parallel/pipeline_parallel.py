@@ -1,25 +1,232 @@
-"""PipelineParallel — host-driven 1F1B (reference:
+"""PipelineParallel — host-driven 1F1B over per-stage JITTED step
+functions (reference:
 python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py —
 unverified, SURVEY.md §0).
 
 The reference runs one process per stage exchanging tensors with NCCL
-p2p; here one controller drives every stage's devices. The 1F1B schedule
-is preserved: warmup forwards fill the pipeline, then forward/backward
-alternate, then cooldown backwards drain it. Because dispatch is async,
-stage k's compute for microbatch i overlaps stage k-1's for microbatch
-i+1 on different devices — the same overlap the reference gets from
-separate processes.
+p2p; here one controller drives every stage's devices. Each pipeline
+chunk gets a compiled forward and a compiled recompute-backward
+(``jax.vjp`` inside jit — activation-light, like per-stage remat), the
+1F1B order is preserved, and inter-stage transfers are explicit
+``device_put``s between stage sub-meshes (ICI p2p). Because dispatch is
+async and stages own disjoint devices, stage k's compute for microbatch
+i overlaps stage k-1's for microbatch i+1 — the overlap the reference
+gets from separate processes.
+
+``PipelineParallelWithInterleave`` segments the model into
+``num_virtual_pipeline_stages`` chunks per stage, placed round-robin
+(chunk c on stage c % S), and runs the same schedule over the finer
+chunk list.
+
+A tape-based eager fallback handles chunks with tuple activations or
+missing loss_fn.
 """
 from __future__ import annotations
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from ....core.tensor import Tensor
+from ....core import autograd
 from .parallel_layers.pp_layers import PipelineLayer
 from .pp_utils.utils import transfer_to_mesh
 from ....parallel.mesh import MeshScope
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
+
+
+class _StageModule:
+    """Thin Layer wrapper over one chunk's items (functional_call target)."""
+
+    def __new__(cls, items):
+        from ....nn.layer.layers import Layer
+
+        class _Mod(Layer):
+            def __init__(self, items_):
+                super().__init__()
+                self._stage_items = items_
+                for i, it in enumerate(items_):
+                    if isinstance(it, Layer):
+                        self.add_sublayer(f"item_{i}", it)
+
+            def forward(self, x):
+                from .pp_utils.utils import run_items
+
+                return run_items(self._stage_items, x)
+
+        return _Mod(items)
+
+
+class _JitPipelineEngine:
+    """Per-chunk compiled fwd/bwd + 1F1B scheduling."""
+
+    def __init__(self, layers: PipelineLayer, hcg, loss_fn):
+        from ....jit import functional_call
+        from ....core.random import traced_key_scope
+
+        self._layers = layers
+        self._hcg = hcg
+        self._loss_fn = loss_fn
+        self._multi = hcg is not None and hcg.num_stages > 1
+        self.chunks = []
+        n = layers.num_chunks
+        for c in range(n):
+            items = layers.get_stage_items(c)
+            mod = _StageModule(items)
+            params = [p for _, p in mod.named_parameters()]
+            mesh = (hcg.get_stage_mesh(layers.chunk_stage(c))
+                    if self._multi else None)
+            last = c == n - 1
+
+            def make_fwd(mod_, with_loss):
+                def fwd_pure(p_vals, x, *rest):
+                    rng = rest[-1]
+                    with autograd.no_grad(), traced_key_scope(rng):
+                        out_t, _ = functional_call(
+                            mod_, mod_.forward,
+                            [Tensor(x, stop_gradient=True)], {}, p_vals, [])
+                        if with_loss:
+                            y, scale = rest[0], rest[1]
+                            loss_t = loss_fn(out_t, Tensor(y, stop_gradient=True))
+                            return loss_t._value * scale
+                    return out_t._value
+
+                return fwd_pure
+
+            fwd_pure = make_fwd(mod, last)
+
+            if last:
+                def make_last(fwd_pure_):
+                    def last_step(p_vals, x, y, scale, seed, rng):
+                        def f(pv, xv):
+                            return fwd_pure_(pv, xv, y, scale, rng)
+
+                        loss, vjp = jax.vjp(f, p_vals, x)
+                        dp, dx = vjp(seed)
+                        return loss, dp, dx
+
+                    return jax.jit(last_step)
+
+                self.chunks.append(dict(
+                    mod=mod, params=params, mesh=mesh,
+                    fwd=None, bwd=make_last(fwd_pure)))
+            else:
+                def make_pair(fwd_pure_):
+                    jfwd = jax.jit(fwd_pure_)
+
+                    def bwd_step(p_vals, x, g, rng):
+                        def f(pv, xv):
+                            return fwd_pure_(pv, xv, rng)
+
+                        _, vjp = jax.vjp(f, p_vals, x)
+                        dp, dx = vjp(g)
+                        return dp, dx
+
+                    return jfwd, jax.jit(bwd_step)
+
+                jf, jb = make_pair(fwd_pure)
+                self.chunks.append(dict(
+                    mod=mod, params=params, mesh=mesh, fwd=jf, bwd=jb))
+
+        self._acc_add = jax.jit(
+            lambda acc, dp: [a + d for a, d in zip(acc, dp)],
+            donate_argnums=0)
+
+    def _to_mesh(self, val, mesh):
+        if mesh is None:
+            return val
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(val, NamedSharding(mesh, PartitionSpec()))
+
+    def run_batch(self, micros, scale_seed=1.0, train=True):
+        """1F1B over the chunk list; returns (mean_loss_value, grads) with
+        grads as {chunk_idx: [g per param]} (None when train=False)."""
+        from ....core.random import next_key
+
+        n = len(self.chunks)
+        m = len(micros)
+        scale = jnp.float32(1.0 / m)
+        seed = jnp.float32(scale_seed)
+        p_vals = [[p._value for p in ch["params"]] for ch in self.chunks]
+        acc = [None] * n
+        stash = {}  # (chunk, micro) -> (x_val, rng) for recompute-bwd
+        losses = []
+
+        def fwd(i):
+            x, y = micros[i]
+            xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            for c in range(n - 1):
+                ch = self.chunks[c]
+                xv = self._to_mesh(xv, ch["mesh"])
+                rng = next_key()
+                stash[(c, i)] = (xv, rng)
+                xv = ch["fwd"](p_vals[c], xv, rng)
+            ch = self.chunks[n - 1]
+            xv = self._to_mesh(xv, ch["mesh"])
+            yv = self._to_mesh(
+                y._value if isinstance(y, Tensor) else jnp.asarray(y),
+                ch["mesh"])
+            stash[(n - 1, i)] = (xv, yv, next_key())
+
+        def bwd(i):
+            ch = self.chunks[n - 1]
+            xv, yv, rng = stash.pop((n - 1, i))
+            loss, dp, dx = ch["bwd"](p_vals[n - 1], xv, yv, scale, seed, rng)
+            losses.append(loss)
+            if train:
+                acc[n - 1] = dp if acc[n - 1] is None else self._acc_add(
+                    acc[n - 1], dp)
+            g = dx
+            for c in range(n - 2, -1, -1):
+                ch = self.chunks[c]
+                xv, rng = stash.pop((c, i))
+                g = self._to_mesh(g, ch["mesh"])
+                dp, dx = ch["bwd"](p_vals[c], xv, g, rng)
+                if train:
+                    acc[c] = dp if acc[c] is None else self._acc_add(acc[c], dp)
+                g = dx
+
+        if not train:
+            # plain forward (loss only): run last chunk fwd via bwd-less path
+            for i in range(m):
+                fwd(i)
+                ch = self.chunks[n - 1]
+                xv, yv, rng = stash.pop((n - 1, i))
+                loss, _, _ = ch["bwd"](p_vals[n - 1], xv, yv, scale, seed, rng)
+                losses.append(loss)
+            mean_loss = float(np.sum([jax.device_get(l) for l in losses]))
+            return mean_loss, None
+
+        # 1F1B: warmup fills the pipeline, steady state alternates
+        warmup = min(n, m)
+        fi = 0
+        for _ in range(warmup):
+            fwd(fi)
+            fi += 1
+        bi = 0
+        while fi < m:
+            bwd(bi)
+            bi += 1
+            fwd(fi)
+            fi += 1
+        while bi < m:
+            bwd(bi)
+            bi += 1
+
+        mean_loss = float(np.sum([jax.device_get(l) for l in losses]))
+        return mean_loss, acc
+
+    def write_grads(self, acc):
+        for ch, grads in zip(self.chunks, acc):
+            if grads is None:
+                continue
+            for p, g in zip(ch["params"], grads):
+                if p.grad is None:
+                    p._grad = Tensor(g)
+                else:
+                    p._grad = Tensor(p.grad._value + g)
 
 
 class PipelineParallel:
@@ -32,7 +239,9 @@ class PipelineParallel:
         pp_cfg = strategy.pipeline_configs
         self._acc_steps = int(pp_cfg.get("accumulate_steps", 1))
         self._micro_batch_size = int(pp_cfg.get("micro_batch_size", 1))
+        self._use_jit = bool(pp_cfg.get("use_jit_engine", True))
         self.num_stages = hcg.num_stages if hcg is not None else layers.num_stages
+        self._engine = None
 
     # expose the wrapped layer API
     def __getattr__(self, name):
@@ -58,6 +267,12 @@ class PipelineParallel:
         self._layers.eval()
         return self
 
+    def _get_engine(self):
+        if self._engine is None:
+            self._engine = _JitPipelineEngine(
+                self._layers, self._hcg, self._layers.loss_fn)
+        return self._engine
+
     def _split_micro_batches(self, data):
         """data: (inputs, labels) paddle-style → list of micro (x, y)."""
         x, y = data
@@ -74,17 +289,18 @@ class PipelineParallel:
         return micros
 
     def _forward_micro(self, x):
-        """Forward one microbatch through all stages w/ inter-stage moves."""
+        """Eager fallback: forward one microbatch through all chunks."""
         out = x
+        n = self._layers.num_chunks
         multi = self.num_stages > 1 and self._hcg is not None
-        for s in range(self.num_stages):
+        for c in range(n):
             if multi:
-                mesh = self._hcg.get_stage_mesh(s)
+                mesh = self._hcg.get_stage_mesh(self._layers.chunk_stage(c))
                 out = transfer_to_mesh(out, mesh)
                 with MeshScope(mesh):
-                    out = self._layers.forward_stage(out, s)
+                    out = self._layers.forward_stage(out, c)
             else:
-                out = self._layers.forward_stage(out, s)
+                out = self._layers.forward_stage(out, c)
         return out
 
     def _compute_loss(self, out, label):
@@ -96,9 +312,33 @@ class PipelineParallel:
     def forward_backward_pipeline(self, data, scaler=None):
         """Run the 1F1B schedule; returns the MEAN microbatch loss."""
         micros = self._split_micro_batches(data)
+        if self._use_jit:
+            validated = getattr(self, "_engine_validated", False)
+            try:
+                engine = self._get_engine()
+                seed = (float(scaler.get_loss_scaling())
+                        if scaler is not None else 1.0)
+                loss, acc = engine.run_batch(micros, scale_seed=seed)
+                engine.write_grads(acc)
+                self._engine_validated = True
+                return loss
+            except Exception as e:
+                if validated:
+                    raise  # engine worked before — this is a real error
+                import warnings
+
+                warnings.warn(
+                    f"pipeline jit engine unavailable ({e.__class__.__name__}:"
+                    f" {e}); falling back to the eager tape schedule",
+                    RuntimeWarning)
+                self._use_jit = False
+                self._engine = None
+        return self._eager_forward_backward(micros, scaler)
+
+    def _eager_forward_backward(self, micros, scaler=None):
         m = len(micros)
         num_warmup = min(self.num_stages, m)
-        pending = []  # scaled losses awaiting backward (1F1B window)
+        pending = []
         all_losses = []
 
         def fwd(i):
@@ -106,38 +346,6 @@ class PipelineParallel:
             out = self._forward_micro(x)
             loss = self._compute_loss(out, y)
             all_losses.append(loss)
-            scaled = loss / m
-            if scaler is not None:
-                scaled = scaler.scale(scaled)
-            return scaled
-
-        fwd_i = 0
-        for _ in range(num_warmup):  # warmup forwards fill the pipeline
-            pending.append(fwd(fwd_i))
-            fwd_i += 1
-        while fwd_i < m:  # steady state: one backward per forward
-            pending.pop(0).backward()
-            pending.append(fwd(fwd_i))
-            fwd_i += 1
-        while pending:  # cooldown backwards drain it
-            pending.pop(0).backward()
-        return float(
-            sum(float(l.numpy()) for l in all_losses) / max(m, 1)
-        )
-
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        self._layers.train()
-        micros = self._split_micro_batches(data)
-        m = len(micros)
-        losses = []
-        num_warmup = min(self.num_stages, m)
-        pending = []
-
-        def fwd(i):
-            x, y = micros[i]
-            out = self._forward_micro(x)
-            loss = self._compute_loss(out, y)
-            losses.append(loss)
             scaled = loss / m
             if scaler is not None:
                 scaled = scaler.scale(scaled)
@@ -153,6 +361,13 @@ class PipelineParallel:
             fwd_i += 1
         while pending:
             pending.pop(0).backward()
+        return float(
+            sum(float(l.numpy()) for l in all_losses) / max(m, 1)
+        )
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
 
         if scaler is not None:
             scaler.step(optimizer)
@@ -162,14 +377,10 @@ class PipelineParallel:
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        from ....tensor.manipulation import stack
-        from ....tensor.math import mean
-
-        return mean(stack([l.detach() for l in losses]))
+        return Tensor(jnp.float32(loss))
 
     def eval_batch(self, data, compute_loss=True):
         self._layers.eval()
-        from ....core import autograd
 
         with autograd.no_grad():
             micros = self._split_micro_batches(data)
@@ -189,7 +400,17 @@ class PipelineParallel:
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved (virtual-stage) schedule. With a single controller the
-    device-overlap benefit of virtual stages is already captured by async
-    dispatch; the schedule reduces to 1F1B over the finer stage list."""
-    pass
+    """Interleaved (virtual-stage) 1F1B: the wrapped PipelineLayer must be
+    built with ``num_virtual_pipeline_stages > 1``; chunks are placed
+    round-robin over the physical stages and the schedule runs over the
+    finer chunk list (same engine — the chunk list IS the interleaving)."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        if layers.num_chunks == layers.num_stages:
+            import warnings
+
+            warnings.warn(
+                "PipelineParallelWithInterleave without "
+                "num_virtual_pipeline_stages>1 degrades to plain 1F1B",
+                RuntimeWarning)
