@@ -6,3 +6,7 @@ from ..layers.mpu.mp_layers import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from . import sharding  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    ring_flash_attention, ulysses_attention, sep_attention,
+    split_inputs_sequence_dim,
+)

@@ -45,8 +45,8 @@ class LlamaConfig:
                  max_position_embeddings=4096, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
                  tensor_parallel=True, sequence_parallel=False,
-                 use_recompute=False, recompute_granularity="full",
-                 dtype="float32"):
+                 context_parallel=None, use_recompute=False,
+                 recompute_granularity="full", dtype="float32"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -59,6 +59,8 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.tensor_parallel = tensor_parallel
         self.sequence_parallel = sequence_parallel
+        # context parallelism over the sep axis: None | "ring" | "ulysses"
+        self.context_parallel = context_parallel
         self.use_recompute = use_recompute
         self.recompute_granularity = recompute_granularity
         self.dtype = dtype
@@ -97,8 +99,10 @@ def _mark_hidden(t, config):
     data axis), seq over sep when sequence-parallel."""
     if not mesh_state.has_mesh():
         return t
-    seq_axis = "sep" if (config.sequence_parallel
-                         and mesh_state.mesh_axis_size("sep") > 1) else None
+    seq_axis = "sep" if (
+        (config.sequence_parallel or config.context_parallel)
+        and mesh_state.mesh_axis_size("sep") > 1
+    ) else None
 
     def fn(v):
         return mesh_state.constraint(v, "dp", seq_axis, None)
@@ -158,6 +162,16 @@ class LlamaAttention(Layer):
             # out (B, S_max, HK, D) with valid length = position_offset + s
             k, v, cache = self._update_cache(k, v, cache, position_offset)
             out = self._decode_attend(q, k, v, position_offset + s)
+        elif (self.config.context_parallel
+              and mesh_state.mesh_axis_size("sep") > 1):
+            from ..distributed.fleet.meta_parallel.context_parallel import (
+                sep_attention,
+            )
+
+            out = sep_attention(
+                q, k, v, is_causal=True,
+                schedule=self.config.context_parallel,
+            )
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
