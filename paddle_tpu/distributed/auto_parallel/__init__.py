@@ -5,3 +5,4 @@ from .api import (  # noqa: F401
     shard_tensor, dtensor_from_fn, reshard, shard_layer,
     Shard, Replicate, Partial,
 )
+from .engine import Engine  # noqa: F401

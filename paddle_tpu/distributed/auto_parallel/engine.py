@@ -1,0 +1,259 @@
+"""Auto-parallel Engine (reference:
+python/paddle/distributed/auto_parallel/static/engine.py — unverified,
+SURVEY.md §0).
+
+The reference Engine parallelizes a serial program through planning /
+partitioning / reshard passes and drives it with a fleet executor. The
+TPU-native Engine is radically smaller because GSPMD *is* the planner:
+install (or build) one ``jax.sharding.Mesh``, let ``shard_tensor``
+annotations and the fleet layers place parameters, and compile the whole
+train step with ``jit`` — the partitioner inserts the collectives the
+reference computes by hand. What remains is exactly the user-facing
+surface: ``fit`` / ``evaluate`` / ``predict`` / ``save`` / ``load``.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ...core.tensor import Tensor
+from ...core import autograd
+from ...parallel import mesh as mesh_state
+from .process_mesh import ProcessMesh
+
+__all__ = ["Engine"]
+
+
+def _install_mesh(mesh, strategy):
+    """Resolve the execution mesh: explicit ProcessMesh/Mesh > fleet
+    strategy > already-installed global mesh > 1D dp mesh over all
+    devices."""
+    if mesh is not None:
+        jmesh = mesh.to_jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+        mesh_state.set_mesh(jmesh)
+        return jmesh
+    if strategy is not None:
+        from .. import fleet
+
+        fleet.init(is_collective=True, strategy=strategy)
+        return mesh_state.get_mesh()
+    if mesh_state.has_mesh():
+        return mesh_state.get_mesh()
+    devs = np.asarray(jax.devices())
+    jmesh = Mesh(devs, ("dp",))
+    mesh_state.set_mesh(jmesh)
+    return jmesh
+
+
+class Engine:
+    """Single-controller train/eval/predict driver over a device mesh.
+
+    Args:
+        model: nn.Layer. Parameters may already carry shardings (fleet
+            TP layers, ``shard_tensor``, ``shard_layer``).
+        loss: callable(output, *labels) -> scalar loss Tensor.
+        optimizer: paddle_tpu Optimizer (required for ``fit``).
+        metrics: optional list of ``paddle.metric.Metric``.
+        strategy: optional ``fleet.DistributedStrategy`` (hybrid_configs
+            builds the dp/sharding/sep/mp mesh).
+        mesh: optional ProcessMesh / jax Mesh overriding everything.
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None, mesh=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = list(metrics) if metrics else []
+        self._mesh = _install_mesh(mesh, strategy)
+        self._train_step = None
+        self._eval_fn = None
+        self._history = {}
+
+    # -- compiled paths -------------------------------------------------
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise ValueError("Engine.fit needs loss and optimizer")
+            from ...jit.train import JittedTrainStep
+
+            sharding_axis = (
+                "sharding" if mesh_state.mesh_axis_size("sharding") > 1
+                else None
+            )
+            self._train_step = JittedTrainStep(
+                self._model, self._loss, self._optimizer,
+                state_sharding_axis=sharding_axis,
+            )
+        return self._train_step
+
+    def _forward(self, inputs):
+        """Jit-compiled no-grad forward through the live Layer."""
+        if self._eval_fn is None:
+            from ...jit import functional_call
+
+            model = self._model
+
+            def fwd(p_vals, b_vals, in_vals):
+                in_t = [Tensor(x, stop_gradient=True) for x in in_vals]
+                with autograd.no_grad():
+                    out, _ = functional_call(
+                        model, model.forward, in_t, {}, p_vals, b_vals
+                    )
+                return jax.tree_util.tree_map(
+                    lambda t: t._value, out,
+                    is_leaf=lambda x: isinstance(x, Tensor),
+                )
+
+            self._eval_fn = jax.jit(fwd)
+        params = [p._value for _, p in self._model.named_parameters()]
+        bufs = [b._value for _, b in self._model.named_buffers()]
+        vals = [x._value if isinstance(x, Tensor) else np.asarray(x)
+                for x in inputs]
+        out = self._eval_fn(params, bufs, vals)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v, stop_gradient=True), out
+        )
+
+    # -- data plumbing --------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        from ...io import DataLoader, Dataset, IterableDataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, (Dataset, IterableDataset)):
+            return DataLoader(
+                data, batch_size=batch_size, shuffle=shuffle, drop_last=True
+            )
+        return data  # any iterable of (inputs, labels) batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 2:
+                ins, lbs = batch
+            else:
+                ins, lbs = batch[0], batch[1:]
+        else:
+            ins, lbs = batch, []
+        to_list = lambda x: list(x) if isinstance(x, (list, tuple)) else [x]
+        return to_list(ins), to_list(lbs)
+
+    # -- public API -----------------------------------------------------
+    def fit(self, train_data=None, valid_data=None, train_sample_split=None,
+            batch_size=1, epochs=1, steps_per_epoch=None, log_freq=10,
+            shuffle=True, verbose=1, collate_fn=None, callbacks=None,
+            **kwargs):
+        step = self._ensure_train_step()
+        loader = self._loader(train_data, batch_size, shuffle)
+        if loader is None:
+            raise ValueError("Engine.fit: train_data is required")
+        history = {"loss": []}
+        for epoch in range(epochs):
+            loss = None
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                ins, lbs = self._split_batch(batch)
+                loss = step(ins, lbs)
+                if verbose and (i % log_freq == 0):
+                    print(
+                        f"[Engine] epoch {epoch} step {i} "
+                        f"loss {float(loss):.6f}",
+                        file=sys.stderr,
+                    )
+            if loss is None:
+                raise ValueError(
+                    "Engine.fit: train_data produced no batches (dataset "
+                    f"smaller than batch_size={batch_size}?)"
+                )
+            history["loss"].append(float(loss))
+            if valid_data is not None:
+                eval_out = self.evaluate(
+                    valid_data, batch_size=batch_size, verbose=0
+                )
+                for k, val in eval_out.items():
+                    history.setdefault(k, []).append(val)
+        step.sync_to_model()
+        self._history = history
+        return history
+
+    def evaluate(self, valid_data=None, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, verbose=1, collate_fn=None,
+                 callbacks=None, **kwargs):
+        loader = self._loader(valid_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        total, count = 0.0, 0
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            ins, lbs = self._split_batch(batch)
+            out = self._forward(ins)
+            if self._loss is not None:
+                lb_t = [x if isinstance(x, Tensor) else Tensor(x)
+                        for x in lbs]
+                total += float(self._loss(out, *lb_t))
+                count += 1
+            for m in self._metrics:
+                m.update(
+                    *[np.asarray(v._value) for v in
+                      jax.tree_util.tree_leaves(m.compute(out, *lbs))]
+                ) if hasattr(m, "compute") else m.update(out, *lbs)
+        result = {}
+        if count:
+            result["loss"] = total / count
+        for m in self._metrics:
+            result[m.name() if callable(getattr(m, "name", None)) else "metric"] = (
+                m.accumulate()
+            )
+        if verbose:
+            print(f"[Engine] eval {result}", file=sys.stderr)
+        return result
+
+    def predict(self, test_data=None, test_sample_split=None, batch_size=1,
+                steps=None, verbose=0, collate_fn=None, callbacks=None,
+                **kwargs):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        outputs = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            ins, _ = self._split_batch(batch)
+            outputs.append(self._forward(ins))
+        return outputs
+
+    def save(self, path, training=True):
+        from ...framework.io import save
+
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework.io import load
+
+        self._model.set_state_dict(load(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(load(path + ".pdopt"))
+        # drop compiled closures over the old param values
+        self._train_step = None
+        self._eval_fn = None
+
+    @property
+    def main_program(self):  # reference-API shim: XLA owns the program
+        return None
+
+    @property
+    def history(self):
+        return self._history

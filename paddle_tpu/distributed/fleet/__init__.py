@@ -117,3 +117,6 @@ class _FleetFacade:
 
 
 fleet = _FleetFacade()
+
+# reference spelling: `from paddle.distributed.fleet import auto`
+from .. import auto_parallel as auto  # noqa: E402,F401

@@ -110,8 +110,11 @@ def _finalize(o, m, l, dtype):
 
 def _ring_local(q, k, v, *, axis, n, causal, scale):
     """Body run per-device under shard_map: q,k,v are the local shards
-    (B, S/n, H, D); returns the local output shard."""
-    k, v = _repeat_kv(q, k, v)
+    (B, S/n, H, D); returns the local output shard.
+
+    K/V rotate at their native (GQA) head count — the repeat to the query
+    head count happens per block, locally, so ring ICI traffic stays at
+    HK-sized volume."""
     idx = lax.axis_index(axis)
     sq = q.shape[1]
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -123,17 +126,20 @@ def _ring_local(q, k, v, *, axis, n, causal, scale):
         k_pos = src * sq + jnp.arange(sq)
         return q_pos[:, None] >= k_pos[None, :]
 
+    def _block(kb, vb, src):
+        kr, vr = _repeat_kv(q, kb, vb)
+        return _block_attn(q, kr, vr, scale, _mask(src))
+
     # step 0: the resident block — folded outside the scan so the ring
     # does exactly n-1 permutes (the n-th rotation's result is dead)
-    acc = _block_attn(q, k, v, scale, _mask(idx))
+    acc = _block(k, v, idx)
 
     def step(carry, t):
         kb, vb, o, m, l = carry
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
         src = (idx - t) % n  # which device's block we now hold
-        blk = _block_attn(q, kb, vb, scale, _mask(src))
-        o, m, l = _combine((o, m, l), blk)
+        o, m, l = _combine((o, m, l), _block(kb, vb, src))
         return (kb, vb, o, m, l), None
 
     if n > 1:
@@ -143,22 +149,17 @@ def _ring_local(q, k, v, *, axis, n, causal, scale):
 
 def _ulysses_local(q, k, v, *, axis, n, causal, scale):
     """All-to-all reshard seq→heads, local full attention, reshard back."""
+    from ....nn.functional.attention import _xla_attention
+
     if k.shape[2] % n != 0:  # GQA heads not splittable: expand first
         k, v = _repeat_kv(q, k, v)
     # (B, S/n, H, D) → (B, S, H/n, D)
     q = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
-    k, v = _repeat_kv(q, k, v)  # expand after the reshard at HK-sized comm
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    if causal:
-        sq = s.shape[-2]
-        mask = jnp.tril(jnp.ones((sq, sq), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+    # _xla_attention expands any remaining GQA gap after the reshard, so
+    # the all_to_all moved K/V at their native HK-sized volume
+    o = _xla_attention(q, k, v, causal=causal, scale=scale)
     # (B, S, H/n, D) → (B, S/n, H, D)
     return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
@@ -183,25 +184,42 @@ def _sep_call(local_fn, query, key, value, is_causal, scale, axis):
         return scaled_dot_product_attention(
             query, key, value, is_causal=is_causal
         )
-    if query._value.shape[1] % n != 0:
+    b, s, h, _ = query._value.shape
+    hk = key._value.shape[2]
+    if s % n != 0:
         raise ValueError(
-            f"context parallelism requires seq len ({query._value.shape[1]}) "
-            f"divisible by sep degree ({n})"
-        )
-    if local_fn is _ulysses_local and query._value.shape[2] % n != 0:
-        raise ValueError(
-            f"ulysses requires num_heads ({query._value.shape[2]}) divisible "
-            f"by sep degree ({n}); use ring_flash_attention instead"
+            f"context parallelism requires seq len ({s}) divisible by sep "
+            f"degree ({n})"
         )
 
-    spec = P(None, axis, None, None)
+    # Carry the surrounding hybrid axes into the shard_map so GSPMD does
+    # NOT all-gather over dp/mp: batch stays dp-sharded and heads stay
+    # mp-sharded (TP attention heads are already split by the column-
+    # parallel projections); the ring/all_to_all runs only over ``sep``.
+    def _axis_if(name, dim):
+        sz = mesh_state.mesh_axis_size(name)
+        return name if (sz > 1 and dim % sz == 0) else None
+
+    batch_ax = _axis_if("dp", b)
+    head_ax = _axis_if("mp", h) if _axis_if("mp", h) == _axis_if("mp", hk) \
+        else None
+    mp = mesh_state.mesh_axis_size("mp") if head_ax else 1
+
+    if local_fn is _ulysses_local and (h // mp) % n != 0:
+        raise ValueError(
+            f"ulysses requires local num_heads ({h // mp}) divisible by "
+            f"sep degree ({n}); use ring_flash_attention instead"
+        )
+
+    q_spec = P(batch_ax, axis, head_ax, None)
+    kv_spec = P(batch_ax, axis, head_ax, None)
     fn = shard_map(
         functools.partial(
             local_fn, axis=axis, n=n, causal=is_causal, scale=scale
         ),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
     )
     return apply(fn, query, key, value, op_name="sep_attention")
 
