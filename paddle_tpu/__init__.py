@@ -104,3 +104,6 @@ from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import nlp  # noqa: E402
 from . import profiler  # noqa: E402
+from . import fft  # noqa: E402
+from . import quantization  # noqa: E402
+from . import sparse  # noqa: E402

@@ -11,8 +11,8 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = tensors
 
-    @property
     def saved_tensor(self):
+        # method, matching paddle.autograd.PyLayerContext.saved_tensor()
         return self._saved
 
 
@@ -54,6 +54,18 @@ class PyLayer:
                         grads.append(g._value if isinstance(g, Tensor) else g)
                 return tuple(grads)
 
+            def taped_vjp(cot_tensors):
+                # create_graph path: run the user's backward with grad
+                # recording ON so the produced grads stay on the tape
+                gin = cls.backward(ctx, *cot_tensors)
+                gin = (gin,) if isinstance(gin, Tensor) else tuple(gin)
+                t_inputs = [a for a in args if isinstance(a, Tensor)]
+                grads = []
+                for t, g in zip(t_inputs, gin):
+                    if not t.stop_gradient:
+                        grads.append(g)
+                return tuple(grads)
+
             flat, treedef = jax.tree_util.tree_flatten(tuple(t._value for t in outs))
             node = ag.Node(
                 vjp_fn,
@@ -61,6 +73,7 @@ class PyLayer:
                 [],
                 treedef,
                 name=cls.__name__,
+                taped_vjp=taped_vjp,
             )
             for t in outs:
                 t._stop_gradient = False

@@ -95,16 +95,29 @@ class GradSlot:
 
 
 class Node:
-    """One recorded op: cotangents in → input cotangents out."""
+    """One recorded op: cotangents in → input cotangents out.
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "treedef", "name", "__weakref__")
+    ``closed``/``primals`` (the op as a pure function of its
+    differentiable inputs, and those inputs' values) enable
+    ``create_graph``: the backward walk can re-derive the VJP *through
+    the dispatch seam* so the grad computation is itself taped."""
 
-    def __init__(self, vjp_fn, inputs, outputs, treedef, name=""):
+    __slots__ = ("vjp_fn", "inputs", "outputs", "treedef", "name",
+                 "closed", "primals", "taped_vjp", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, outputs, treedef, name="",
+                 closed=None, primals=None, taped_vjp=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[GradSlot] — the differentiable inputs
         self.outputs = outputs  # list[(GradSlot, shape, jnp_dtype)]
         self.treedef = treedef  # structure of the raw fn output
         self.name = name
+        self.closed = closed
+        self.primals = primals
+        # create_graph path for ops whose VJP is user Python (PyLayer):
+        # called with cotangent *Tensors*, returns grad Tensors recorded
+        # on the tape
+        self.taped_vjp = taped_vjp
 
     def __repr__(self):
         return f"<Node {self.name or 'op'} n_in={len(self.inputs)}>"
@@ -146,13 +159,46 @@ def _run_hooks(owner, g):
     if owner is None:
         return g
     for hook in owner._grad_hooks:
-        new_g = hook(Tensor(g, stop_gradient=True))
+        h_in = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+        new_g = hook(h_in)
         if new_g is not None:
-            g = new_g._value if isinstance(new_g, Tensor) else new_g
+            g = new_g
     return g
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
+def _replay_vjp(node, cots):
+    """Re-derive ``node``'s VJP through the dispatch seam so the grad
+    computation is recorded on the tape (create_graph=True path).
+
+    The primal wrappers alias the *forward* slots, so second-order
+    cotangents flow back into the original graph — d(grad)/d(x) sees the
+    dependence of the residuals on x, which the stored ``vjp_fn``
+    closure (constants baked in) cannot express."""
+    from .tensor import Tensor
+    from .dispatch import apply as dispatch_apply
+
+    n_primal = len(node.primals)
+    wrappers = []
+    for slot, pv in zip(node.inputs, node.primals):
+        w = Tensor(pv, stop_gradient=False)
+        w._slot = slot
+        wrappers.append(w)
+    closed, treedef = node.closed, node.treedef
+
+    def vjp_replay(*vals):
+        pvs = vals[:n_primal]
+        cvs = list(vals[n_primal:])
+        _, vjp_fn = jax.vjp(closed, *pvs)
+        return tuple(vjp_fn(jax.tree_util.tree_unflatten(treedef, cvs)))
+
+    return dispatch_apply(
+        vjp_replay, *wrappers, *cots,
+        op_name=(node.name or "op") + "_grad",
+    )
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, _grad_sink=None):
     """Run reverse accumulation from ``tensors``.
 
     Matches paddle.autograd.backward semantics: default cotangent is ones
@@ -160,6 +206,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
     ``_grad_sink`` (a dict), grads are collected into the sink keyed by
     ``id(owner)`` instead of written to ``.grad`` — used by paddle.grad so
     it never pollutes ``.grad`` of uninvolved leaves.
+
+    With ``create_graph=True`` every node's VJP is replayed through the
+    dispatch seam, so the produced grads are themselves differentiable
+    (reference: double-grad nodes in paddle/fluid/eager/ — unverified).
     """
     from .tensor import Tensor
     import jax.numpy as jnp
@@ -170,6 +220,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
+    if create_graph:
+        retain_graph = True
 
     cotangents: dict[int, object] = {}
     keepalive: dict[int, GradSlot] = {}
@@ -179,6 +231,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
             oid = id(owner)
             _grad_sink[oid] = _grad_sink[oid] + g if oid in _grad_sink else g
         else:
+            if isinstance(g, Tensor):
+                g = g._value
             owner._set_grad_accum(g)
 
     def _accum(slot, g):
@@ -199,8 +253,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
             # paddle fills the initial gradient with ones for roots of any
             # shape (grad_tensor=None semantics), not just scalars
             g = jnp.ones(t._value.shape, t._value.dtype)
+            if create_graph:
+                g = Tensor(g, stop_gradient=True)
+        elif isinstance(g, Tensor):
+            g = g if create_graph else g._value
         else:
-            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            g = jnp.asarray(g)
         slot = t._ensure_slot()
         _accum(slot, g)
         root_slots.append(slot)
@@ -223,14 +281,30 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _grad_sink=None):
                 ):
                     _deliver(owner, g)
             cots.append(g)
-        if not any_live or node.vjp_fn is None:
+        if not any_live or (node.vjp_fn is None and node.closed is None):
             continue
-        cot_struct = jax.tree_util.tree_unflatten(node.treedef, cots)
-        in_grads = node.vjp_fn(cot_struct)
+        if create_graph and node.closed is not None:
+            in_grads = _replay_vjp(node, cots)
+        elif create_graph and node.taped_vjp is not None:
+            cot_t = [
+                c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True)
+                for c in cots
+            ]
+            in_grads = node.taped_vjp(cot_t)
+        elif create_graph:
+            raise RuntimeError(
+                f"create_graph=True cannot differentiate through "
+                f"'{node.name or 'op'}' (no replayable forward recorded)"
+            )
+        else:
+            cots = [c._value if isinstance(c, Tensor) else c for c in cots]
+            cot_struct = jax.tree_util.tree_unflatten(node.treedef, cots)
+            in_grads = node.vjp_fn(cot_struct)
         for slot, g in zip(node.inputs, in_grads):
             _accum(slot, g)
         if not retain_graph:
             node.vjp_fn = None  # free residuals eagerly
+            node.closed = node.primals = None
 
     # Write .grad on leaves.
     for sid, slot in keepalive.items():
@@ -258,17 +332,13 @@ def grad(
 ):
     """paddle.grad: grads of ``outputs`` w.r.t. ``inputs`` (always a list).
 
-    ``create_graph`` (double backward) is not supported in round 1 — the
-    perf path for higher-order grads is ``paddle.jit`` + ``jax.grad``
-    composition.
+    ``create_graph=True`` returns grads that are themselves on the tape
+    (the VJPs are replayed through the dispatch seam), so gradient-
+    penalty losses compose: ``paddle.grad(..., create_graph=True)`` then
+    ``loss.backward()``.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported in eager mode; compose "
-            "paddle_tpu.jit grad transforms instead"
-        )
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if grad_outputs is None:
@@ -280,10 +350,12 @@ def grad(
     for t in inputs:
         t._retain_grad_flag = True  # collect even if t is an intermediate
     sink: dict[int, object] = {}
+    if retain_graph is None:
+        retain_graph = create_graph
     try:
         backward(
             outputs, grad_outputs, retain_graph=bool(retain_graph),
-            _grad_sink=sink,
+            create_graph=create_graph, _grad_sink=sink,
         )
         results = []
         for t in inputs:
@@ -295,6 +367,8 @@ def grad(
                         "allow_unused=True to return None for it"
                     )
                 results.append(None)
+            elif isinstance(g, Tensor):
+                results.append(g)  # create_graph: still on the tape
             else:
                 results.append(Tensor(g, stop_gradient=True))
     finally:

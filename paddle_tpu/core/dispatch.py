@@ -131,6 +131,8 @@ def apply(fn, *args, op_name: str = "", **kwargs):
         [],
         treedef,
         name=op_name or getattr(fn, "__name__", "op"),
+        closed=closed,
+        primals=primals,
     )
     for t in wrapped:
         slot = autograd.GradSlot(owner=t, node=node if not t.stop_gradient else None)

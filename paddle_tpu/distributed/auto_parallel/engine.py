@@ -109,8 +109,14 @@ class Engine:
                 )
 
             self._eval_fn = jax.jit(fwd)
-        params = [p._value for _, p in self._model.named_parameters()]
-        bufs = [b._value for _, b in self._model.named_buffers()]
+        if self._train_step is not None:
+            # the train step owns the live (donated) buffers; the Layer's
+            # p._value may point at deleted arrays mid-fit
+            params = list(self._train_step._p_vals)
+            bufs = list(self._train_step._b_vals)
+        else:
+            params = [p._value for _, p in self._model.named_parameters()]
+            bufs = [b._value for _, b in self._model.named_buffers()]
         vals = [x._value if isinstance(x, Tensor) else np.asarray(x)
                 for x in inputs]
         out = self._eval_fn(params, bufs, vals)
@@ -119,7 +125,7 @@ class Engine:
         )
 
     # -- data plumbing --------------------------------------------------
-    def _loader(self, data, batch_size, shuffle):
+    def _loader(self, data, batch_size, shuffle, drop_last=False):
         from ...io import DataLoader, Dataset, IterableDataset
 
         if data is None:
@@ -127,8 +133,11 @@ class Engine:
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, (Dataset, IterableDataset)):
+            # drop_last only for the fixed-shape jitted train step;
+            # evaluate/predict keep the final partial batch
             return DataLoader(
-                data, batch_size=batch_size, shuffle=shuffle, drop_last=True
+                data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last,
             )
         return data  # any iterable of (inputs, labels) batches
 
@@ -150,7 +159,7 @@ class Engine:
             shuffle=True, verbose=1, collate_fn=None, callbacks=None,
             **kwargs):
         step = self._ensure_train_step()
-        loader = self._loader(train_data, batch_size, shuffle)
+        loader = self._loader(train_data, batch_size, shuffle, drop_last=True)
         if loader is None:
             raise ValueError("Engine.fit: train_data is required")
         history = {"loss": []}
@@ -178,7 +187,7 @@ class Engine:
                     valid_data, batch_size=batch_size, verbose=0
                 )
                 for k, val in eval_out.items():
-                    history.setdefault(k, []).append(val)
+                    history.setdefault("val_" + k, []).append(val)
         step.sync_to_model()
         self._history = history
         return history
@@ -187,6 +196,8 @@ class Engine:
                  steps=None, log_freq=10, verbose=1, collate_fn=None,
                  callbacks=None, **kwargs):
         loader = self._loader(valid_data, batch_size, shuffle=False)
+        if loader is None:
+            raise ValueError("Engine.evaluate: valid_data is required")
         for m in self._metrics:
             m.reset()
         total, count = 0.0, 0
@@ -220,6 +231,8 @@ class Engine:
                 steps=None, verbose=0, collate_fn=None, callbacks=None,
                 **kwargs):
         loader = self._loader(test_data, batch_size, shuffle=False)
+        if loader is None:
+            raise ValueError("Engine.predict: test_data is required")
         outputs = []
         for i, batch in enumerate(loader):
             if steps is not None and i >= steps:

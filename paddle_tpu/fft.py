@@ -1,0 +1,162 @@
+"""paddle.fft — discrete Fourier transforms (reference:
+python/paddle/fft.py — unverified, SURVEY.md §0).
+
+Thin dispatch-seam wrappers over ``jnp.fft``: XLA lowers FFTs natively
+(TPU executes them on the VPU), and routing through ``apply`` gives the
+tape autograd + AMP/nan-check for free. ``norm`` semantics follow the
+reference ("backward" | "ortho" | "forward"), which match numpy's.
+When the active accelerator backend lacks complex-dtype support (the
+axon TPU tunnel does; full XLA:TPU does not), transforms are offloaded
+to the host CPU backend eagerly — correct but not accelerator-speed; a
+clear error is raised if such an FFT is traced inside ``jit``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor._helpers import apply, ensure_tensor, axes_arg
+
+_COMPLEX_OK = None
+
+
+def _complex_supported():
+    # Static platform rule — a *failed* complex op poisons the axon
+    # runtime (every later dispatch errors), so probing is not an option.
+    # cpu/gpu XLA backends have full complex support; the tunneled TPU
+    # backend here has none, so TPU routes to the host fallback.
+    global _COMPLEX_OK
+    if _COMPLEX_OK is None:
+        _COMPLEX_OK = jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+    return _COMPLEX_OK
+
+
+def _host_fft(np_fn, v, **kw):
+    """Run the transform on the host CPU backend; the result lives on the
+    cpu device (real-valued results transfer back transparently)."""
+    if isinstance(v, jax.core.Tracer):
+        raise RuntimeError(
+            "this backend has no complex-dtype support, so FFT cannot be "
+            "traced under jit here; call it eagerly (host-offloaded)"
+        )
+    out = np_fn(np.asarray(v), **kw)
+    dtype = np.complex64 if out.dtype == np.complex128 else (
+        np.float32 if out.dtype == np.float64 else out.dtype
+    )
+    return jax.device_put(out.astype(dtype), jax.devices("cpu")[0])
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(
+            f"norm must be 'backward', 'ortho' or 'forward', got {norm!r}"
+        )
+    return norm
+
+
+def _wrap1(jnp_fn, op_name):
+    np_fn = getattr(np.fft, op_name)
+
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        from .core.tensor import Tensor
+
+        x = ensure_tensor(x)
+        nrm = _norm(norm)
+        if not _complex_supported():
+            # host offload is opaque to the tape: no FFT grads here
+            return Tensor(
+                _host_fft(np_fn, x._value, n=n, axis=axis, norm=nrm),
+                stop_gradient=True,
+            )
+        return apply(
+            lambda v: jnp_fn(v, n=n, axis=axis, norm=nrm), x,
+            op_name=op_name,
+        )
+
+    op.__name__ = op_name
+    op.__doc__ = f"paddle.fft.{op_name}(x, n=None, axis=-1, norm='backward')"
+    return op
+
+
+def _wrap_nd(jnp_fn, op_name, default_axes):
+    np_fn = getattr(np.fft, op_name)
+
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
+        from .core.tensor import Tensor
+
+        x = ensure_tensor(x)
+        ax = axes_arg(axes)
+        nrm = _norm(norm)
+        if not _complex_supported():
+            return Tensor(
+                _host_fft(np_fn, x._value, s=s, axes=ax, norm=nrm),
+                stop_gradient=True,
+            )
+        return apply(
+            lambda v: jnp_fn(v, s=s, axes=ax, norm=nrm), x,
+            op_name=op_name,
+        )
+
+    op.__name__ = op_name
+    op.__doc__ = (
+        f"paddle.fft.{op_name}(x, s=None, axes={default_axes}, "
+        f"norm='backward')"
+    )
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+
+fft2 = _wrap_nd(jnp.fft.fft2, "fft2", (-2, -1))
+ifft2 = _wrap_nd(jnp.fft.ifft2, "ifft2", (-2, -1))
+rfft2 = _wrap_nd(jnp.fft.rfft2, "rfft2", (-2, -1))
+irfft2 = _wrap_nd(jnp.fft.irfft2, "irfft2", (-2, -1))
+fftn = _wrap_nd(jnp.fft.fftn, "fftn", None)
+ifftn = _wrap_nd(jnp.fft.ifftn, "ifftn", None)
+rfftn = _wrap_nd(jnp.fft.rfftn, "rfftn", None)
+irfftn = _wrap_nd(jnp.fft.irfftn, "irfftn", None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.dtype import to_jax_dtype
+
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(to_jax_dtype(dtype))
+    return apply(lambda: out, op_name="fftfreq")
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.dtype import to_jax_dtype
+
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(to_jax_dtype(dtype))
+    return apply(lambda: out, op_name="rfftfreq")
+
+
+def fftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axes)
+    return apply(lambda v: jnp.fft.fftshift(v, axes=ax), x, op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axes)
+    return apply(
+        lambda v: jnp.fft.ifftshift(v, axes=ax), x, op_name="ifftshift"
+    )

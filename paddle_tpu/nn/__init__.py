@@ -12,3 +12,4 @@ from ..optimizer.clip import (  # noqa: F401
     ClipGradByNorm,
     ClipGradByGlobalNorm,
 )
+from . import quant  # noqa: E402,F401

@@ -1,0 +1,204 @@
+"""paddle.nn.quant — quantization ops and layers (reference:
+python/paddle/nn/quant/ — unverified, SURVEY.md §0).
+
+TPU-first mechanics:
+
+- Fake quantization (QAT) is a straight-through estimator expressed as
+  ``x + stop_gradient(q(x) - x)`` inside ONE dispatch op — the tape's
+  VJP is identity, matching the reference's fake_quantize grad kernels.
+- ``weight_only_linear`` stores int8 weights + per-channel scales and
+  dequantizes INTO the matmul (XLA fuses the scale multiply into the
+  MXU feed — HBM traffic is the win, exactly like the reference's
+  weight-only GEMM epilogue).
+- ``a8w8_linear`` runs a true int8×int8 ``dot_general`` with int32
+  accumulation (the MXU's native int8 path) and rescales the output.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+from ..layer.layers import Layer
+
+__all__ = [
+    "fake_quantize_dequantize_abs_max",
+    "quantize_linear", "dequantize_linear",
+    "weight_quantize", "weight_dequantize", "weight_only_linear",
+    "a8w8_linear",
+    "QuantizedLinear",
+]
+
+
+def fake_quantize_dequantize_abs_max(x, bits=8, name=None):
+    """Per-tensor abs-max fake quant-dequant with STE gradient."""
+    x = ensure_tensor(x)
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(v):
+        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
+        q = jnp.clip(jnp.round(v / scale), -qmax - 1, qmax) * scale
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply(fn, x, op_name="fake_quantize_dequantize_abs_max")
+
+
+def quantize_linear(x, scale, zero_point=0, bits=8, axis=None, name=None):
+    """Quantize to int8 given a scale (per-tensor or per-channel on
+    ``axis``)."""
+    x = ensure_tensor(x)
+    scale = ensure_tensor(scale)
+    qmax = 2 ** (bits - 1) - 1
+
+    def fn(v, s):
+        if axis is not None and s.ndim == 1:
+            shape = [1] * v.ndim
+            shape[axis] = -1
+            s = s.reshape(shape)
+        q = jnp.clip(jnp.round(v / s) + zero_point, -qmax - 1, qmax)
+        return q.astype(jnp.int8)
+
+    return apply(fn, x, scale, op_name="quantize_linear")
+
+
+def dequantize_linear(x, scale, zero_point=0, axis=None, name=None):
+    x = ensure_tensor(x)
+    scale = ensure_tensor(scale)
+
+    def fn(q, s):
+        if axis is not None and s.ndim == 1:
+            shape = [1] * q.ndim
+            shape[axis] = -1
+            s = s.reshape(shape)
+        return (q.astype(s.dtype) - zero_point) * s
+
+    return apply(fn, x, scale, op_name="dequantize_linear")
+
+
+def weight_quantize(x, algo="weight_only_int8", name=None):
+    """Per-output-channel int8 weight quantization.
+
+    x: (in_features, out_features) float weight. Returns (int8 weight,
+    float scales[out_features]). Reference analog:
+    paddle.nn.quant.weight_quantize.
+    """
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported weight quantize algo: {algo}")
+    x = ensure_tensor(x)
+
+    def fn(w):
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w / scale[None, :]), -128, 127)
+        return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+    return apply(fn, x, op_name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
+    return dequantize_linear(x, scale, axis=1)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", name=None):
+    """y = x @ dequant(weight) + bias — weight stays int8 in HBM; the
+    dequant multiply fuses into the matmul epilogue under XLA."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale")
+    weight_scale = ensure_tensor(weight_scale)
+    args = [x, weight, weight_scale]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def fn(xv, wq, ws, *maybe_b):
+        w = wq.astype(xv.dtype) * ws.astype(xv.dtype)[None, :]
+        y = xv @ w
+        if maybe_b:
+            y = y + maybe_b[0]
+        return y
+
+    return apply(fn, *args, op_name="weight_only_linear")
+
+
+def a8w8_linear(x, weight, x_scale, weight_scale, bias=None, name=None):
+    """int8 activation × int8 weight with int32 accumulation — the MXU's
+    native int8 path; output rescaled to float."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    x_scale = ensure_tensor(x_scale)
+    weight_scale = ensure_tensor(weight_scale)
+    args = [x, weight, x_scale, weight_scale]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def fn(xq, wq, xs, ws, *maybe_b):
+        acc = jax.lax.dot_general(
+            xq, wq,
+            dimension_numbers=(((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        y = acc.astype(jnp.float32) * xs * ws[None, :]
+        if maybe_b:
+            y = y + maybe_b[0]
+        return y
+
+    return apply(fn, *args, op_name="a8w8_linear")
+
+
+class QuantizedLinear(Layer):
+    """int8 Linear produced by PTQ/QAT convert.
+
+    Without an activation scale it runs weight-only (dequant fused into
+    the matmul). With one (PTQ calibration observed it) it quantizes the
+    activations too and takes the a8w8 int32-accumulation MXU path."""
+
+    def __init__(self, in_features, out_features, has_bias=True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.quant_weight = self.create_parameter(
+            (in_features, out_features), dtype="int8",
+            default_initializer=lambda shape, dtype: jnp.zeros(
+                shape, jnp.int8),
+        )
+        self.quant_weight.stop_gradient = True
+        self.weight_scale = self.create_parameter(
+            (out_features,), dtype="float32",
+            default_initializer=lambda shape, dtype: jnp.ones(
+                shape, jnp.float32),
+        )
+        self.weight_scale.stop_gradient = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), dtype="float32", is_bias=True)
+        self.act_scale = None  # float: set by PTQ convert from observers
+
+    @staticmethod
+    def from_linear(linear, act_scale=None):
+        qw, scale = weight_quantize(linear.weight)
+        out = QuantizedLinear(
+            linear.weight.shape[0], linear.weight.shape[1],
+            has_bias=linear.bias is not None,
+        )
+        out.quant_weight.set_value(qw)
+        out.weight_scale.set_value(scale)
+        if linear.bias is not None:
+            out.bias.set_value(linear.bias)
+        out.act_scale = act_scale
+        return out
+
+    def forward(self, x):
+        if self.act_scale is not None:
+            xs = float(self.act_scale)
+            qx = quantize_linear(
+                x, Tensor(jnp.float32(xs), stop_gradient=True)
+            )
+            return a8w8_linear(
+                qx, self.quant_weight, Tensor(jnp.float32(xs)),
+                self.weight_scale, self.bias,
+            )
+        return weight_only_linear(
+            x, self.quant_weight, self.bias, self.weight_scale
+        )

@@ -1,0 +1,159 @@
+"""paddle.quantization — QAT / PTQ front-end (reference:
+python/paddle/quantization/ — unverified, SURVEY.md §0).
+
+Workflow parity with the reference:
+
+    q_config = QuantConfig(activation=FakeQuanterWithAbsMax(),
+                           weight=FakeQuanterWithAbsMax())
+    qat = QAT(q_config)
+    q_model = qat.quantize(model)       # Linear -> QuantedLinear (STE)
+    ... train ...
+    infer = qat.convert(q_model)        # -> weight-only int8 layers
+
+    ptq = PTQ(q_config)
+    q_model = ptq.quantize(model)       # observers record abs-max
+    ... run calibration batches ...
+    infer = ptq.convert(q_model)
+
+All quantized math lives in ``paddle.nn.quant`` (fake-quant STE ops,
+int8 weight-only matmul, a8w8 int32-accumulation dot)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn.quant import (
+    fake_quantize_dequantize_abs_max, QuantizedLinear, weight_quantize,
+)
+from ..tensor._helpers import Tensor
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "FakeQuanterWithAbsMax", "AbsmaxObserver",
+    "QuantedLinear",
+]
+
+
+class FakeQuanterWithAbsMax:
+    """Quanter factory: per-tensor abs-max fake quant with STE grad."""
+
+    def __init__(self, quant_bits=8, name=None):
+        self.quant_bits = quant_bits
+
+    def __call__(self, x):
+        return fake_quantize_dequantize_abs_max(x, bits=self.quant_bits)
+
+
+class AbsmaxObserver:
+    """PTQ observer: tracks the running max |x| over calibration runs."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self.absmax = 0.0
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        self.absmax = max(self.absmax, float(jnp.max(jnp.abs(v))))
+
+    def scale(self):
+        qmax = float(2 ** (self.quant_bits - 1) - 1)
+        return max(self.absmax, 1e-8) / qmax
+
+
+class QuantConfig:
+    """Global activation/weight quanter config (the reference's
+    per-layer/per-type maps degrade to this global default; extend via
+    ``add_type_config`` later if needed)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantedLinear(Layer):
+    """QAT wrapper: fake-quant weight (and optionally input) around a
+    live Linear — grads flow via STE to the float master weight."""
+
+    def __init__(self, linear: Linear, q_config: QuantConfig):
+        super().__init__()
+        self._inner = linear
+        self._act_quanter = q_config.activation
+        self._w_quanter = q_config.weight
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        w = self._inner.weight
+        if self._w_quanter is not None:
+            w = self._w_quanter(w)
+        if self._act_quanter is not None:
+            x = self._act_quanter(x)
+        return F.linear(x, w, self._inner.bias)
+
+
+class _ObservedLinear(Layer):
+    """PTQ wrapper: plain forward + activation observation."""
+
+    def __init__(self, linear: Linear, q_config: QuantConfig):
+        super().__init__()
+        self._inner = linear
+        self.observer = AbsmaxObserver(
+            getattr(q_config.activation, "quant_bits", 8) or 8
+        )
+
+    def forward(self, x):
+        self.observer.observe(x)
+        return self._inner(x)
+
+
+def _replace_linears(layer, factory):
+    for name, sub in list(layer._sub_layers.items()):
+        if isinstance(sub, Linear):
+            layer._sub_layers[name] = factory(sub)
+        else:
+            _replace_linears(sub, factory)
+    return layer
+
+
+def _convert_wrapped(layer):
+    for name, sub in list(layer._sub_layers.items()):
+        if isinstance(sub, _ObservedLinear):
+            # calibration observed the activation range → a8w8 path
+            act_scale = sub.observer.scale() if sub.observer.absmax > 0 \
+                else None
+            layer._sub_layers[name] = QuantizedLinear.from_linear(
+                sub._inner, act_scale=act_scale
+            )
+        elif isinstance(sub, QuantedLinear):
+            layer._sub_layers[name] = QuantizedLinear.from_linear(sub._inner)
+        else:
+            _convert_wrapped(sub)
+    return layer
+
+
+class QAT:
+    def __init__(self, q_config: QuantConfig):
+        self._config = q_config
+
+    def quantize(self, model, inplace=True):
+        return _replace_linears(
+            model, lambda lin: QuantedLinear(lin, self._config)
+        )
+
+    def convert(self, model, inplace=True):
+        return _convert_wrapped(model)
+
+
+class PTQ:
+    def __init__(self, q_config: QuantConfig = None):
+        self._config = q_config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        return _replace_linears(
+            model, lambda lin: _ObservedLinear(lin, self._config)
+        )
+
+    def convert(self, model, inplace=True):
+        return _convert_wrapped(model)

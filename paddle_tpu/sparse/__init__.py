@@ -1,0 +1,305 @@
+"""paddle.sparse — COO/CSR sparse tensors (reference:
+python/paddle/sparse/ — unverified, SURVEY.md §0).
+
+TPU-native substrate: ``jax.experimental.sparse.BCOO`` — XLA lowers its
+matmuls to gather/scatter + MXU-friendly dense contractions, which is
+the honest TPU story for sparsity (the hardware has no sparse unit; the
+reference's cuSPARSE kernels map to this + the compiler). CSR is kept
+as a thin indexing facade over the same BCOO buffer.
+
+Scope: construction (``sparse_coo_tensor``, ``sparse_csr_tensor``,
+``Tensor.to_sparse_coo`` analog ``to_sparse_coo``), conversion
+(``to_dense``), elementwise unary (relu/sin/tanh/... on values),
+add/mul, and ``matmul`` (sparse @ dense). Autograd flows through
+``matmul``/``to_dense`` via the dense values operand."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..tensor._helpers import apply, ensure_tensor
+
+from . import nn  # noqa: E402,F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor",
+    "sparse_coo_tensor", "sparse_csr_tensor", "to_sparse_coo", "to_dense",
+    "is_sparse_coo", "is_sparse_csr",
+    "add", "multiply", "matmul", "masked_matmul",
+    "relu", "sin", "tanh", "abs", "sqrt", "square", "neg", "pow",
+    "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over a BCOO buffer.
+
+    ``values`` participates in autograd as a dense Tensor: ops rebuild
+    the BCOO from (indices, values) inside the dispatch seam so grads
+    flow to ``values`` (and onward to whatever produced them)."""
+
+    is_sparse = True
+
+    def __init__(self, indices, values: Tensor, shape):
+        self._indices = jnp.asarray(
+            indices._value if isinstance(indices, Tensor) else indices
+        ).astype(jnp.int32)  # (ndim, nnz)
+        self._values = values  # Tensor (nnz, ...)
+        self._shape = tuple(int(s) for s in shape)
+
+    # -- construction helpers -------------------------------------------
+    @staticmethod
+    def from_bcoo(mat: jsparse.BCOO):
+        return SparseCooTensor(
+            mat.indices.T, Tensor(mat.data, stop_gradient=True), mat.shape
+        )
+
+    def _bcoo_of(self, values_val):
+        return jsparse.BCOO(
+            (values_val, self._indices.T), shape=self._shape
+        )
+
+    # -- reference-parity surface ---------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def indices(self):
+        return Tensor(self._indices, stop_gradient=True)
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def to_dense(self):
+        idx = self._indices
+
+        def fn(v):
+            return self._bcoo_of(v).todense()
+
+        return apply(fn, self._values, op_name="sparse_to_dense")
+
+    def coalesce(self):
+        mat = self._bcoo_of(self._values._value).sum_duplicates()
+        out = SparseCooTensor.from_bcoo(mat)
+        out._values.stop_gradient = self._values.stop_gradient
+        return out
+
+    def __repr__(self):
+        return (
+            f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+            f"dtype={self.dtype})"
+        )
+
+
+class SparseCsrTensor:
+    """CSR facade: (crows, cols, values) kept verbatim; compute paths
+    convert to COO (same buffers, reindexed) and share BCOO lowering."""
+
+    is_sparse = True
+
+    def __init__(self, crows, cols, values: Tensor, shape):
+        self._crows = jnp.asarray(
+            crows._value if isinstance(crows, Tensor) else crows
+        ).astype(jnp.int32)
+        self._cols = jnp.asarray(
+            cols._value if isinstance(cols, Tensor) else cols
+        ).astype(jnp.int32)
+        self._values = values
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D only")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def crows(self):
+        return Tensor(self._crows, stop_gradient=True)
+
+    def cols(self):
+        return Tensor(self._cols, stop_gradient=True)
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def to_sparse_coo(self):
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz())
+        idx = jnp.stack([rows, self._cols])
+        return SparseCooTensor(idx, self._values, self._shape)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (
+            f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+            f"dtype={self.dtype})"
+        )
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    values = ensure_tensor(values, dtype=dtype)
+    idx = jnp.asarray(
+        indices._value if isinstance(indices, Tensor) else indices
+    )
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    out = SparseCooTensor(idx, values, shape)
+    out.stop_gradient = stop_gradient and values.stop_gradient
+    return out
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    values = ensure_tensor(values, dtype=dtype)
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor → SparseCooTensor (reference Tensor.to_sparse_coo)."""
+    x = ensure_tensor(x)
+    mat = jsparse.BCOO.fromdense(x._value)
+    values = apply(
+        lambda v: v[tuple(mat.indices.T)], x, op_name="dense_to_sparse_values"
+    )
+    return SparseCooTensor(mat.indices.T, values, x.shape)
+
+
+def to_dense(x):
+    return x.to_dense() if hasattr(x, "to_dense") else ensure_tensor(x)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"expected sparse tensor, got {type(x)}")
+    return x
+
+
+def _unary(jnp_fn, name, zero_preserving_only=True):
+    def op(x, *args, **kwargs):
+        x = _coo(x)
+        vals = apply(
+            lambda v: jnp_fn(v, *args, **kwargs), x._values,
+            op_name=f"sparse_{name}",
+        )
+        return SparseCooTensor(x._indices, vals, x._shape)
+
+    op.__name__ = name
+    op.__doc__ = (
+        f"paddle.sparse.{name}: applied to stored values "
+        f"(zero-preserving op, zeros stay implicit)."
+    )
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+sin = _unary(jnp.sin, "sin")
+tanh = _unary(jnp.tanh, "tanh")
+abs = _unary(jnp.abs, "abs")  # noqa: A001 — reference name
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+neg = _unary(jnp.negative, "neg")
+
+
+def pow(x, factor):  # noqa: A001 — reference name
+    return _unary(jnp.power, "pow")(x, factor)
+
+
+def add(x, y):
+    """sparse + sparse → sparse (union of patterns, coalesced)."""
+    x, y = _coo(x), _coo(y)
+    if x._shape != y._shape:
+        raise ValueError(f"shape mismatch: {x._shape} vs {y._shape}")
+    idx = jnp.concatenate([x._indices, y._indices], axis=1)
+
+    def fn(xv, yv):
+        vals = jnp.concatenate([xv, yv], axis=0)
+        mat = jsparse.BCOO((vals, idx.T), shape=x._shape).sum_duplicates(
+            nse=idx.shape[1]
+        )
+        return mat.data, mat.indices
+
+    vals, new_idx = apply(fn, x._values, y._values, op_name="sparse_add")
+    return SparseCooTensor(new_idx._value.T, vals, x._shape)
+
+
+def multiply(x, y):
+    """Elementwise sparse * dense or sparse * scalar."""
+    x = _coo(x)
+    if isinstance(x._values, Tensor) and isinstance(y, (int, float)):
+        vals = x._values * y
+        return SparseCooTensor(x._indices, vals, x._shape)
+    y = ensure_tensor(y)
+    idx = x._indices
+
+    def fn(v, dense):
+        return v * dense[tuple(idx)]
+
+    vals = apply(fn, x._values, y, op_name="sparse_multiply_dense")
+    return SparseCooTensor(idx, vals, x._shape)
+
+
+def matmul(x, y):
+    """sparse @ dense → dense (the TPU-relevant direction: SpMM)."""
+    x = _coo(x)
+    y = ensure_tensor(y)
+    idx = x._indices
+    shape = x._shape
+
+    def fn(v, dense):
+        mat = jsparse.BCOO((v, idx.T), shape=shape)
+        return mat @ dense
+
+    return apply(fn, x._values, y, op_name="sparse_matmul")
+
+
+def masked_matmul(x, y, mask):
+    """(dense @ dense) sampled at ``mask``'s sparsity pattern (SDDMM)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    mask = _coo(mask)
+    idx = mask._indices
+
+    def fn(a, b):
+        rows, cols = idx[0], idx[1]
+        return jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+
+    vals = apply(fn, x, y, op_name="sparse_masked_matmul")
+    return SparseCooTensor(idx, vals, mask._shape)

@@ -1,0 +1,45 @@
+"""paddle.sparse.nn — sparse activation layers (reference:
+python/paddle/sparse/nn/ — unverified, SURVEY.md §0). Conv/pooling on
+sparse voxels is out of scope for the TPU build (no hardware win);
+activations and BatchNorm-style value transforms are provided."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import relu
+
+        return relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a 2-D COO matrix's stored values."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 (rows)")
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from jax import ops as jops
+        from .. import SparseCooTensor, _coo
+        from ...tensor._helpers import apply
+
+        x = _coo(x)
+        rows = x._indices[0]
+        n_rows = x._shape[0]
+
+        def fn(v):
+            row_max = jnp.full((n_rows,), -jnp.inf, v.dtype).at[rows].max(v)
+            e = jnp.exp(v - row_max[rows])
+            row_sum = jnp.zeros((n_rows,), v.dtype).at[rows].add(e)
+            return e / row_sum[rows]
+
+        vals = apply(fn, x._values, op_name="sparse_softmax")
+        return SparseCooTensor(x._indices, vals, x._shape)
+
+
+__all__ = ["ReLU", "Softmax"]

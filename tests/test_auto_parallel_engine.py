@@ -109,3 +109,37 @@ def test_engine_shard_tensor_annotated_model():
     eng = Engine(model, _loss(), opt, mesh=mesh)
     hist = eng.fit(_ToyData(), batch_size=16, epochs=2, verbose=0)
     assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_engine_fit_with_validation_data():
+    """Per-epoch evaluate must read the live (donated) train-step params."""
+    model = _mlp()
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    eng = Engine(model, _loss(), opt, metrics=[Accuracy()])
+    hist = eng.fit(
+        _ToyData(), valid_data=_ToyData(seed=1), batch_size=16, epochs=3,
+        verbose=0,
+    )
+    assert len(hist["loss"]) == 3 and len(hist["val_acc"]) == 3
+    assert len(hist["val_loss"]) == 3
+
+
+def test_engine_predict_keeps_partial_batch():
+    model = _mlp()
+    eng = Engine(model, _loss(), paddle.optimizer.Adam(
+        1e-2, parameters=model.parameters()))
+    outs = eng.predict(_ToyData(n=50), batch_size=16)
+    total = sum(o.shape[0] for o in outs)
+    assert total == 50  # 16+16+16+2 — final partial batch kept
+
+
+def test_engine_missing_data_raises():
+    model = _mlp()
+    eng = Engine(model, _loss(), paddle.optimizer.Adam(
+        1e-2, parameters=model.parameters()))
+    with pytest.raises(ValueError, match="train_data"):
+        eng.fit()
+    with pytest.raises(ValueError, match="valid_data"):
+        eng.evaluate()
+    with pytest.raises(ValueError, match="test_data"):
+        eng.predict()

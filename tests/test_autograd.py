@@ -152,8 +152,10 @@ def test_pylayer():
     np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
 
 
-def test_double_backward_raises():
-    x = paddle.to_tensor(1.0, stop_gradient=False)
+def test_double_backward_supported():
+    # full coverage in tests/test_double_backward.py
+    x = paddle.to_tensor(3.0, stop_gradient=False)
     y = x * x
-    with pytest.raises(NotImplementedError):
-        paddle.grad(y, x, create_graph=True)
+    (g,) = paddle.grad(y, x, create_graph=True)
+    (gg,) = paddle.grad(g, x)
+    np.testing.assert_allclose(float(gg), 2.0, rtol=1e-6)

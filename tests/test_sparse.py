@@ -1,0 +1,131 @@
+"""paddle.sparse COO/CSR facade over BCOO (SURVEY.md §2.4 sparse row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _dense():
+    return np.array(
+        [[0, 2.0, 0, 0], [1.0, 0, 0, 3.0], [0, 0, 0, 0], [4.0, 0, 5.0, 0]],
+        dtype="f4",
+    )
+
+
+def test_coo_construct_and_to_dense():
+    d = _dense()
+    idx = np.array(np.nonzero(d))
+    vals = d[tuple(idx)]
+    s = sparse.sparse_coo_tensor(idx, vals, d.shape)
+    assert s.nnz() == 5
+    np.testing.assert_allclose(np.asarray(s.to_dense()._value), d)
+
+
+def test_to_sparse_coo_roundtrip():
+    d = _dense()
+    s = sparse.to_sparse_coo(paddle.to_tensor(d))
+    np.testing.assert_allclose(np.asarray(s.to_dense()._value), d)
+    np.testing.assert_allclose(
+        np.asarray(s.values()._value), d[np.nonzero(d)]
+    )
+
+
+def test_csr_construct_and_convert():
+    d = _dense()
+    crows = np.array([0, 1, 3, 3, 5], "i4")
+    cols = np.array([1, 0, 3, 0, 2], "i4")
+    vals = np.array([2.0, 1.0, 3.0, 4.0, 5.0], "f4")
+    s = sparse.sparse_csr_tensor(crows, cols, vals, d.shape)
+    assert s.nnz() == 5
+    np.testing.assert_allclose(np.asarray(s.to_dense()._value), d)
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(np.asarray(coo.to_dense()._value), d)
+
+
+def test_unary_ops_zero_preserving():
+    d = _dense()
+    s = sparse.to_sparse_coo(paddle.to_tensor(d))
+    np.testing.assert_allclose(
+        np.asarray(sparse.sin(s).to_dense()._value), np.sin(d), rtol=1e-6
+    )
+    neg = sparse.neg(s)
+    np.testing.assert_allclose(
+        np.asarray(sparse.relu(neg).to_dense()._value), np.maximum(-d, 0)
+    )
+
+
+def test_sparse_add():
+    d1, d2 = _dense(), _dense().T.copy()
+    s1 = sparse.to_sparse_coo(paddle.to_tensor(d1))
+    s2 = sparse.to_sparse_coo(paddle.to_tensor(d2))
+    out = sparse.add(s1, s2)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value), d1 + d2)
+
+
+def test_spmm_matmul_and_grad():
+    d = _dense()
+    rng = np.random.RandomState(0)
+    y_np = rng.randn(4, 3).astype("f4")
+    x = paddle.to_tensor(d)
+    x.stop_gradient = False
+    s = sparse.to_sparse_coo(x)  # values track back to x
+    y = paddle.to_tensor(y_np)
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(
+        np.asarray(out._value), d @ y_np, rtol=1e-5, atol=1e-5
+    )
+    out.sum().backward()
+    # d(sum(S@Y))/dx is Y.sum(1) broadcast at nonzero positions
+    expect = np.zeros_like(d)
+    expect[np.nonzero(d)] = y_np.sum(1)[np.nonzero(d)[1]]
+    np.testing.assert_allclose(
+        np.asarray(x.grad._value), expect, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(1)
+    a = rng.randn(4, 8).astype("f4")
+    b = rng.randn(8, 4).astype("f4")
+    mask = sparse.to_sparse_coo(paddle.to_tensor(_dense()))
+    out = sparse.masked_matmul(
+        paddle.to_tensor(a), paddle.to_tensor(b), mask
+    )
+    full = a @ b
+    expect = np.zeros_like(full)
+    nz = np.nonzero(_dense())
+    expect[nz] = full[nz]
+    np.testing.assert_allclose(
+        np.asarray(out.to_dense()._value), expect, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_sparse_softmax():
+    d = _dense()
+    s = sparse.to_sparse_coo(paddle.to_tensor(d))
+    sm = sparse.nn.Softmax()
+    out = np.asarray(sm(s).to_dense()._value)
+    # rows with entries: softmax over the stored values only
+    for r in range(4):
+        nz = np.nonzero(d[r])[0]
+        if len(nz):
+            e = np.exp(d[r][nz] - d[r][nz].max())
+            np.testing.assert_allclose(
+                out[r][nz], e / e.sum(), rtol=1e-5
+            )
+
+
+def test_multiply_scalar_and_dense():
+    d = _dense()
+    s = sparse.to_sparse_coo(paddle.to_tensor(d))
+    np.testing.assert_allclose(
+        np.asarray(sparse.multiply(s, 2.0).to_dense()._value), d * 2
+    )
+    w = np.full_like(d, 3.0)
+    np.testing.assert_allclose(
+        np.asarray(
+            sparse.multiply(s, paddle.to_tensor(w)).to_dense()._value
+        ),
+        d * 3,
+    )
