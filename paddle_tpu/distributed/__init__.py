@@ -207,3 +207,4 @@ from .auto_parallel.api import shard_tensor  # noqa: E402
 from . import auto_parallel  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
+from . import elastic  # noqa: E402,F401

@@ -4,3 +4,6 @@
 and reshards to the current mesh.
 """
 from .save_load import save_state_dict, load_state_dict  # noqa: F401
+from .async_save import (  # noqa: F401
+    async_save_state_dict, AsyncSaveHandle, CheckpointManager,
+)

@@ -1,0 +1,164 @@
+"""Async + managed checkpointing (reference: the reference's async save
+hooks and PaddleNLP's unified checkpoint; SURVEY.md §5 checkpoint/resume
+— unverified).
+
+TPU-native mechanics: ``jax.device_get`` snapshots device state to host
+(blocking only for the D2H copy — training's next step overlaps the disk
+write), then a background thread serializes. ``CheckpointManager`` keeps
+the last-k step directories, atomically publishes completed saves
+(write to ``.tmp`` then rename), and resumes from the newest complete
+checkpoint."""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+from .save_load import save_state_dict, load_state_dict
+
+__all__ = ["async_save_state_dict", "AsyncSaveHandle", "CheckpointManager"]
+
+
+class AsyncSaveHandle:
+    def __init__(self, thread, errbox):
+        self._thread = thread
+        self._err = errbox
+
+    def result(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint save still in flight")
+        if self._err:
+            raise self._err[0]
+
+    wait = result
+
+    def done(self):
+        return not self._thread.is_alive()
+
+
+def _snapshot(state_dict):
+    """D2H copy of every tensor NOW (so training can mutate/donate the
+    device buffers immediately after this returns)."""
+    snap = {}
+    for k, t in state_dict.items():
+        if isinstance(t, Tensor):
+            snap[k] = Tensor(np.asarray(jax.device_get(t._value)))
+        else:
+            snap[k] = t
+    return snap
+
+
+def async_save_state_dict(state_dict, path, process_group=None,
+                          coordinator_rank=0):
+    """Snapshot synchronously, write in the background. Returns an
+    ``AsyncSaveHandle``; the write is atomic (tmp dir + rename)."""
+    snap = _snapshot(state_dict)
+    errbox: list = []
+
+    def run():
+        tmp = path + ".tmp"
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            save_state_dict(snap, tmp, process_group, coordinator_rank)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            os.replace(tmp, path)
+        except BaseException as e:  # surfaced via handle.result()
+            errbox.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return AsyncSaveHandle(t, errbox)
+
+
+class CheckpointManager:
+    """Step-indexed checkpoint directory manager with retention.
+
+    Layout: ``<root>/step_<n>/`` per checkpoint + ``<root>/LATEST``
+    marker written only after the save completes — a torn save is never
+    resumed from."""
+
+    def __init__(self, root, max_to_keep=3, async_save=True):
+        self.root = root
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._inflight = None
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, step):
+        return os.path.join(self.root, f"step_{step}")
+
+    def save(self, step, state_dict):
+        self.wait()
+        path = self._dir(step)
+        if self.async_save:
+            handle = async_save_state_dict(state_dict, path)
+            errbox: list = []
+
+            def publish():
+                try:
+                    handle.result()
+                    self._publish(step)
+                except BaseException as e:  # surfaced via wait()/result()
+                    errbox.append(e)
+
+            t = threading.Thread(target=publish, daemon=True)
+            t.start()
+            self._inflight = AsyncSaveHandle(t, errbox)
+            return self._inflight
+        save_state_dict(state_dict, path)
+        self._publish(step)
+        return None
+
+    def _publish(self, step):
+        with open(os.path.join(self.root, "LATEST.tmp"), "w") as f:
+            json.dump({"step": step}, f)
+        os.replace(
+            os.path.join(self.root, "LATEST.tmp"),
+            os.path.join(self.root, "LATEST"),
+        )
+        self._gc()
+
+    def _gc(self):
+        steps = sorted(self.all_steps())
+        for s in steps[: -self.max_to_keep]:
+            shutil.rmtree(self._dir(s), ignore_errors=True)
+
+    def all_steps(self):
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("step_") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return out
+
+    def latest_step(self):
+        marker = os.path.join(self.root, "LATEST")
+        if not os.path.exists(marker):
+            return None
+        with open(marker) as f:
+            step = json.load(f)["step"]
+        return step if os.path.isdir(self._dir(step)) else None
+
+    def restore(self, state_dict, step=None):
+        """Load (resharding to current placements) from ``step`` or the
+        newest published checkpoint. Returns the restored step or None."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        load_state_dict(state_dict, self._dir(step))
+        return step
+
+    def wait(self):
+        if self._inflight is not None:
+            self._inflight.result()
+            self._inflight = None

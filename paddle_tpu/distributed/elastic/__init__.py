@@ -1,0 +1,105 @@
+"""paddle.distributed.elastic — preemption/failure handling (reference:
+python/paddle/distributed/elastic*.py and fleet elastic manager —
+unverified, SURVEY.md §0).
+
+The reference's etcd-backed elastic manager watches membership and
+restarts ranks; on a TPU pod the platform (GKE/Borg) owns restart, so
+the framework's job is the two ends the platform can't do:
+
+- **PreemptionGuard**: catch SIGTERM (the preemption signal), finish the
+  current step, flush a checkpoint, exit cleanly.
+- **resume**: on restart, find the newest complete checkpoint via
+  ``CheckpointManager`` and continue.
+
+``ElasticManager`` wraps both around a train loop."""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from ..checkpoint.async_save import CheckpointManager
+
+__all__ = ["PreemptionGuard", "ElasticManager"]
+
+
+class PreemptionGuard:
+    """Context manager: arms SIGTERM/SIGINT(optional) to set a flag
+    instead of killing the process, so the train loop can checkpoint.
+
+    Usage::
+
+        with PreemptionGuard() as guard:
+            for step, batch in enumerate(loader):
+                train_step(batch)
+                if guard.preempted:
+                    manager.save(step, state); break
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,), callback=None):
+        self._signals = signals
+        self._callback = callback
+        self._prev = {}
+        self._event = threading.Event()
+
+    @property
+    def preempted(self):
+        return self._event.is_set()
+
+    def _handler(self, signum, frame):
+        self._event.set()
+        if self._callback is not None:
+            self._callback(signum)
+
+    def __enter__(self):
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        return False
+
+
+class ElasticManager:
+    """Checkpointed, preemption-aware train-loop driver.
+
+    Args:
+        ckpt_dir: checkpoint root (CheckpointManager layout).
+        save_interval: steps between periodic saves.
+        max_to_keep / async_save: forwarded to CheckpointManager.
+    """
+
+    def __init__(self, ckpt_dir, save_interval=100, max_to_keep=3,
+                 async_save=True):
+        self.manager = CheckpointManager(
+            ckpt_dir, max_to_keep=max_to_keep, async_save=async_save
+        )
+        self.save_interval = save_interval
+
+    def resume(self, state_dict):
+        """Restore newest checkpoint into state_dict; returns the step to
+        continue from (0 when starting fresh)."""
+        step = self.manager.restore(state_dict)
+        return 0 if step is None else step + 1
+
+    def run(self, state_dict_fn, step_fn, start_step, num_steps):
+        """Drive ``step_fn(step)`` with periodic + preemption saves.
+
+        ``state_dict_fn()`` must return the CURRENT state to snapshot
+        (called at save time, not captured once). Returns the last
+        completed step, or -1 if preempted before any step ran."""
+        last = start_step - 1
+        with PreemptionGuard() as guard:
+            for step in range(start_step, num_steps):
+                step_fn(step)
+                last = step
+                if guard.preempted:
+                    self.manager.save(step, state_dict_fn())
+                    self.manager.wait()
+                    break
+                if (step + 1) % self.save_interval == 0:
+                    self.manager.save(step, state_dict_fn())
+        self.manager.wait()
+        return last

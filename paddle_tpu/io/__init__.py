@@ -9,6 +9,7 @@ same API (csrc/, loaded when built).
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 
@@ -271,12 +272,106 @@ def default_collate_fn(batch):
     return batch
 
 
+def _claim_worker_id(claim_dir):
+    """Filesystem-based worker-id counter: O_EXCL slot files work across
+    any spawn boundary (mp.Value's SemLock does not survive pickling to
+    a spawned pool worker in sandboxed environments)."""
+    i = 0
+    while True:
+        try:
+            fd = os.open(
+                os.path.join(claim_dir, f"w{i}"),
+                os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+            )
+            os.close(fd)
+            return i
+        except FileExistsError:
+            i += 1
+
+
+def _pool_init(dataset, collate_fn, worker_init_fn, claim_dir, num_workers):
+    """Spawned-worker initializer: installs the dataset/collate globals
+    once per worker (pickled once, not per batch) and runs the user's
+    worker_init_fn with a stable worker id.
+
+    Workers must stay off the accelerator — the parent owns the (single)
+    TPU client — so the child is pinned to the CPU backend and collation
+    stays in numpy; the parent tensorizes."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    global _WORKER_DATASET, _WORKER_COLLATE, _worker_info
+    _WORKER_DATASET = dataset
+    _WORKER_COLLATE = collate_fn
+    wid = _claim_worker_id(claim_dir) if claim_dir else 0
+    _worker_info = _WorkerInfo(
+        id=wid, num_workers=num_workers, dataset=dataset
+    )
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+
+
+def _collate_numpy(batch):
+    """default_collate_fn that stays in numpy (worker-process side)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [_collate_numpy(list(g)) for g in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: _collate_numpy([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _tensorize(tree):
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    if isinstance(tree, list):
+        return [_tensorize(t) for t in tree]
+    if isinstance(tree, tuple):
+        return tuple(_tensorize(t) for t in tree)
+    if isinstance(tree, dict):
+        return {k: _tensorize(v) for k, v in tree.items()}
+    return tree
+
+
+def _pool_fetch(indices):
+    samples = [_WORKER_DATASET[i] for i in indices]
+    if _WORKER_COLLATE is None:  # default collate, numpy side
+        return _collate_numpy(samples)
+    return _WORKER_COLLATE(samples)
+
+
+def _pool_warmup():
+    return os.getpid()
+
+
+def _picklable(*objs):
+    import pickle
+
+    try:
+        for o in objs:
+            pickle.dumps(o)
+        return True
+    except Exception:
+        return False
+
+
 class DataLoader:
     """Iterates a Dataset with batching + background prefetch.
 
-    num_workers>0 runs the fetch loop in daemon threads feeding a bounded
-    queue (the BlockingQueue analog); prefetch overlaps host work with
-    device compute. Multiprocess fetch arrives with the C++ io core.
+    ``num_workers>0`` fetches batches in spawned worker *processes*
+    (reference: python/paddle/io/dataloader/worker.py — unverified): the
+    dataset/collate_fn ship to each worker once, batch index lists are
+    dispatched with a bounded in-flight window, and results are yielded
+    strictly in order. Falls back to a daemon prefetch thread when the
+    dataset/collate aren't picklable or the dataset is iterable —
+    spawn (not fork) is mandatory here because a forked child of a
+    process with a live TPU client hangs.
     """
 
     def __init__(self, dataset, feed_list=None, places=None,
@@ -290,6 +385,11 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self.timeout = timeout
+        self._executor = None
+        self._picklable_ok = None  # decided once, on first iteration
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -324,9 +424,76 @@ class DataLoader:
             for batch_idx in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
+    def _ensure_executor(self):
+        if self._executor is None:
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            import tempfile
+
+            ctx = mp.get_context("spawn")
+            claim_dir = tempfile.mkdtemp(prefix="pdtpu_dl_")
+            collate = (None if self.collate_fn is default_collate_fn
+                       else self.collate_fn)
+            ex = ProcessPoolExecutor(
+                max_workers=self.num_workers, mp_context=ctx,
+                initializer=_pool_init,
+                initargs=(self.dataset, collate, self.worker_init_fn,
+                          claim_dir, self.num_workers),
+            )
+            # Spawn every worker NOW with the accelerator disabled in the
+            # inherited env: children unpickle initargs during bootstrap
+            # (before the initializer runs), and neither a Tensor-bearing
+            # dataset nor a TPU-plugin sitecustomize may touch the
+            # parent's (single-client) TPU from a worker.
+            pinned = {
+                "JAX_PLATFORMS": "cpu",
+                # gates the axon sitecustomize's PJRT registration
+                "PALLAS_AXON_POOL_IPS": "",
+            }
+            prev = {k: os.environ.get(k) for k in pinned}
+            os.environ.update(pinned)
+            try:
+                ex.submit(_pool_warmup).result()
+            finally:
+                for k, v in prev.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            self._executor = ex
+        return self._executor
+
+    def _process_iter(self):
+        from collections import deque
+
+        ex = self._ensure_executor()
+        window = self.prefetch_factor * self.num_workers
+        pending = deque()
+        try:
+            for batch_idx in self.batch_sampler:
+                pending.append(ex.submit(_pool_fetch, list(batch_idx)))
+                if len(pending) >= window:
+                    yield _tensorize(pending.popleft().result(
+                        timeout=self.timeout or None))
+            while pending:
+                yield _tensorize(pending.popleft().result(
+                    timeout=self.timeout or None))
+        finally:
+            if not self.persistent_workers:
+                ex.shutdown(wait=False, cancel_futures=True)
+                self._executor = None
+
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._fetch_iter()
+            return
+        if self._picklable_ok is None:
+            self._picklable_ok = (not self._iterable_mode) and _picklable(
+                self.dataset, self.collate_fn, self.worker_init_fn
+            )
+        if self._picklable_ok:
+            yield from self._process_iter()
             return
         # background-thread prefetch pipeline
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)

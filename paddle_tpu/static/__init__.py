@@ -11,7 +11,8 @@ from ..core.dtype import dtype as _dtype
 from ..core.tensor import Tensor
 
 __all__ = [
-    "InputSpec", "Program", "default_main_program", "default_startup_program",
+    "InputSpec", "Program", "Executor", "InferenceProgram",
+    "default_main_program", "default_startup_program",
     "program_guard", "save_inference_model", "load_inference_model", "gradients",
 ]
 
@@ -75,17 +76,229 @@ class program_guard:
         return False
 
 
+def _capture_tape_program(feed_vars, fetch_vars):
+    """Rebuild a pure feeds→fetches function off the eager tape.
+
+    The reference's ProgramDesc is built by op-record during
+    ``enable_static``; here every dispatched op already recorded its
+    closed forward + primal values on the tape (``core.autograd.Node``),
+    so the same graph is recovered by topological replay. Float feeds
+    must carry ``stop_gradient=False`` (only tracked inputs are
+    substitutable — constants are baked)."""
+    from ..core import autograd
+
+    fetch_slots = []
+    for t in fetch_vars:
+        if t._slot is None:
+            fetch_slots.append(None)
+        else:
+            fetch_slots.append(t._slot)
+    order = autograd._toposort([s for s in fetch_slots if s is not None])
+
+    feed_slot_ids = set()
+    for t in feed_vars:
+        if t._slot is None:
+            raise ValueError(
+                "save_inference_model: feed tensor is not on the tape — "
+                "set stop_gradient=False on (float) feeds before running "
+                "the forward, or pass program=<Layer> instead"
+            )
+        feed_slot_ids.add(id(t._slot))
+
+    used = set()
+    for node in order:
+        if node.closed is None:
+            raise ValueError(
+                f"save_inference_model: op '{node.name}' has no replayable "
+                "forward (PyLayer?); pass program=<Layer> instead"
+            )
+        for s in node.inputs:
+            used.add(id(s))
+    missing = feed_slot_ids - used - {
+        id(s) for s in fetch_slots if s is not None
+    }
+    if missing:
+        raise ValueError(
+            "save_inference_model: some feeds never reach the fetches "
+            "on the tape (baked as constants or unused)"
+        )
+
+    feed_ids = [id(t._slot) for t in feed_vars]
+    const_fetch = [
+        None if s is not None else t._value
+        for t, s in zip(fetch_vars, fetch_slots)
+    ]
+    import jax
+
+    def program_fn(*feed_vals):
+        env = dict(zip(feed_ids, feed_vals))
+        for node in order:
+            prims = [
+                env.get(id(s), pv)
+                for s, pv in zip(node.inputs, node.primals)
+            ]
+            out = node.closed(*prims)
+            flat, _ = jax.tree_util.tree_flatten(out)
+            for (slot, _sh, _dt), v in zip(node.outputs, flat):
+                env[id(slot)] = v
+        outs = []
+        for s, cv in zip(fetch_slots, const_fetch):
+            outs.append(cv if s is None else env[id(s)])
+        return tuple(outs)
+
+    return program_fn
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    raise NotImplementedError(
-        "static-graph save_inference_model: use paddle.jit.save (StableHLO export)"
-    )
+    """Export an inference program (reference:
+    python/paddle/static/io.py save_inference_model — unverified).
+
+    Two capture modes:
+    - ``program=<Layer or callable>``: traced via jit.save's exporter
+      with feed shapes from ``feed_vars`` (Tensors or InputSpecs).
+    - default: the feeds→fetches computation is recovered from the
+      eager tape (float feeds need stop_gradient=False) and exported.
+
+    Writes ``{path_prefix}.pdmodel`` (serialized jax.export artifact)
+    and ``{path_prefix}.pdinfo.json`` (feed/fetch metadata)."""
+    import json
+    import os
+    import jax
+    import jax.export as jexport
+
+    feed_vars = list(feed_vars) if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+
+    if program is not None:
+        from ..core.dtype import to_jax_dtype
+
+        # dynamic dims (None/-1) export as jax.export symbolic dims, so
+        # the artifact accepts any batch size — one shared scope so equal
+        # names mean equal sizes
+        scope = jexport.SymbolicScope()
+        n_dyn = 0
+        example = []
+        for spec in feed_vars:
+            if isinstance(spec, InputSpec):
+                dims = []
+                dynamic = False
+                for s in spec.shape:
+                    if s is None or (isinstance(s, int) and s < 0):
+                        dims.append(f"d{n_dyn}")
+                        n_dyn += 1
+                        dynamic = True
+                    else:
+                        dims.append(str(s))
+                if dynamic:
+                    shape = jexport.symbolic_shape(
+                        ",".join(dims), scope=scope
+                    )
+                else:
+                    shape = tuple(int(d) for d in dims)
+                example.append(
+                    jax.ShapeDtypeStruct(shape, to_jax_dtype(spec.dtype))
+                )
+            else:
+                example.append(spec._value)
+
+        from ..core import autograd as ag
+
+        def program_fn(*feed_vals):
+            with ag.no_grad():
+                out = program(*[Tensor(v, stop_gradient=True)
+                                for v in feed_vals])
+            flat, _ = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            return tuple(
+                t._value if isinstance(t, Tensor) else t for t in flat
+            )
+    else:
+        example = [t._value for t in feed_vars]
+        program_fn = _capture_tape_program(feed_vars, fetch_vars)
+
+    exported = jexport.export(jax.jit(program_fn))(*example)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    feed_names = [
+        getattr(v, "name", None) or f"feed_{i}"
+        for i, v in enumerate(feed_vars)
+    ]
+    fetch_names = [
+        getattr(v, "name", None) or f"fetch_{i}"
+        for i, v in enumerate(fetch_vars)
+    ]
+    with open(path_prefix + ".pdinfo.json", "w") as f:
+        json.dump({"feed_names": feed_names, "fetch_names": fetch_names}, f)
+
+
+class InferenceProgram:
+    """Loaded inference program: callable, and runnable via Executor."""
+
+    def __init__(self, exported, feed_names, fetch_names):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def __call__(self, *feeds):
+        vals = [
+            f._value if isinstance(f, Tensor) else np.asarray(f)
+            for f in feeds
+        ]
+        outs = self._exported.call(*vals)
+        return [Tensor(o, stop_gradient=True) for o in outs]
+
+    def global_block(self):
+        return self
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "static-graph load_inference_model: use paddle.jit.load"
+    """Returns ``[program, feed_target_names, fetch_targets]`` as the
+    reference does; run via ``Executor.run`` or call ``program`` directly."""
+    import json
+    import jax.export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    with open(path_prefix + ".pdinfo.json") as f:
+        info = json.load(f)
+    prog = InferenceProgram(
+        exported, info["feed_names"], info["fetch_names"]
     )
+    return [prog, prog.feed_names, prog.fetch_names]
+
+
+class Executor:
+    """Facade over XLA execution (reference: paddle.static.Executor —
+    the real executor is the compiled jax.export artifact)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if not isinstance(program, InferenceProgram):
+            raise ValueError(
+                "Executor.run expects a program from load_inference_model"
+            )
+        feed = feed or {}
+        args = [feed[name] for name in program.feed_names]
+        outs = program(*args)
+        if fetch_list is not None:
+            picked = []
+            for f in fetch_list:
+                name = f if isinstance(f, str) else getattr(f, "name", None)
+                if name not in program.fetch_names:
+                    raise KeyError(
+                        f"fetch {name!r} not in program fetches "
+                        f"{program.fetch_names}"
+                    )
+                picked.append(outs[program.fetch_names.index(name)])
+            outs = picked
+        return [np.asarray(o._value) for o in outs]
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -1,0 +1,89 @@
+"""Multiprocess DataLoader workers (SURVEY.md §2.4 DataLoader row;
+reference python/paddle/io/dataloader/worker.py — unverified)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class RangeSquares(Dataset):
+    """Module-level (picklable) dataset."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i) ** 2, np.int64(i)
+
+
+def _bad_getitem(self, i):
+    raise RuntimeError("boom from worker")
+
+
+class Failing(Dataset):
+    def __len__(self):
+        return 8
+
+    __getitem__ = _bad_getitem
+
+
+def _init_fn(worker_id):
+    import os
+
+    os.environ["PADDLE_TPU_TEST_WORKER"] = str(worker_id)
+
+
+def test_process_workers_ordered_and_complete():
+    dl = DataLoader(RangeSquares(32), batch_size=4, num_workers=2)
+    xs, ys = [], []
+    for x, y in dl:
+        xs.append(np.asarray(x._value))
+        ys.append(np.asarray(y._value))
+    xs = np.concatenate(xs)
+    ys = np.concatenate(ys)
+    np.testing.assert_allclose(ys, np.arange(32))  # strict order
+    np.testing.assert_allclose(xs, np.arange(32, dtype="f4") ** 2)
+
+
+def test_process_workers_propagate_errors():
+    dl = DataLoader(Failing(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(dl)
+
+
+def test_persistent_workers_two_epochs():
+    dl = DataLoader(
+        RangeSquares(16), batch_size=4, num_workers=2,
+        persistent_workers=True,
+    )
+    for _ in range(2):
+        ys = np.concatenate([np.asarray(y._value) for _, y in dl])
+        np.testing.assert_allclose(ys, np.arange(16))
+    assert dl._executor is not None  # kept alive across epochs
+    dl._executor.shutdown(wait=False)
+    dl._executor = None
+
+
+def test_unpicklable_dataset_falls_back_to_thread():
+    class Local(Dataset):  # local class: not picklable
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    dl = DataLoader(Local(), batch_size=4, num_workers=2)
+    out = np.concatenate([np.asarray(b._value) for b in dl])
+    np.testing.assert_allclose(out, np.arange(8, dtype="f4"))
+
+
+def test_worker_init_fn_runs():
+    dl = DataLoader(
+        RangeSquares(8), batch_size=4, num_workers=1,
+        worker_init_fn=_init_fn,
+    )
+    assert len(list(dl)) == 2
