@@ -1,7 +1,7 @@
 """Driver benchmark: single-chip Llama-block pretrain step under the
-fully-jitted path (bf16 params + f32 master weights, Pallas flash
-attention, full recompute), reporting MFU against the BASELINE.md
-north-star (45% MFU).
+fully-jitted path (bf16 params + f32 master weights + bf16 Adam moments,
+Pallas flash attention, no activation recompute), reporting MFU against
+the BASELINE.md north-star (45% MFU).
 
 Prints ONE JSON line to stdout; human detail goes to stderr.
 """
@@ -16,7 +16,7 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_step(cfg, batch, seq, lr=1e-4):
+def build_step(cfg, batch, seq, lr=1e-4, moment_dtype="float32"):
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.nlp import LlamaForCausalLM, LlamaPretrainingCriterion
@@ -32,7 +32,7 @@ def build_step(cfg, batch, seq, lr=1e-4):
 
     opt = paddle.optimizer.AdamW(
         lr, parameters=model.parameters(), weight_decay=0.01,
-        multi_precision=True,
+        multi_precision=True, moment_dtype=moment_dtype,
     )
     step = JittedTrainStep(model, criterion, opt)
     ids = paddle.to_tensor(
@@ -63,13 +63,17 @@ def main():
 
     on_tpu = backend == "tpu"
     if on_tpu:
+        # ~941M-param Llama block; measured config sweep on one v5e-16G
+        # (bench notes): bf16 params + f32 master + bf16 Adam moments
+        # frees enough HBM to train WITHOUT activation recompute, which
+        # beats every remat variant (46.8% vs 39.0% full-remat MFU)
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=16,
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=16, num_attention_heads=32,
             max_position_embeddings=4096, tensor_parallel=False,
-            use_recompute=True,
+            use_recompute=False,
         )
-        batch, seq, iters = 8, 2048, 3
+        batch, seq, iters = 2, 2048, 3
     else:  # CPU smoke path so the bench never hard-fails off-TPU
         cfg = LlamaConfig.tiny(tensor_parallel=False)
         batch, seq, iters = 2, 64, 2
@@ -80,7 +84,7 @@ def main():
     K = 10 if on_tpu else 2  # train steps fused into one dispatch
     for attempt in range(3):
         try:
-            model, step, ids = build_step(cfg, batch, seq)
+            model, step, ids = build_step(cfg, batch, seq, moment_dtype="bfloat16" if on_tpu else "float32")
             break
         except Exception as e:  # OOM → halve batch
             if "RESOURCE_EXHAUSTED" not in str(e) or batch == 1:
@@ -115,7 +119,7 @@ def main():
     mfu = res.get("mfu")
     if mfu:
         out = {
-            "metric": "llama_375m_1chip_train_mfu",
+            "metric": "llama_941m_1chip_train_mfu",
             "value": round(mfu * 100, 2),
             "unit": "%MFU",
             "vs_baseline": round(mfu / 0.45, 3),

@@ -266,8 +266,23 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, hidden, position_offset=0, cache=None):
         residual = hidden
-        attn_out, cache = self.self_attn(
-            self.input_layernorm(hidden), position_offset, cache)
+        # PaddleNLP-parity granularities: full_attn/core_attn remat only
+        # the attention sublayer (its softmax/score intermediates), which
+        # keeps the MLP activations resident
+        attn_remat = (self.config.use_recompute and cache is None
+                      and self.config.recompute_granularity
+                      in ("full_attn", "core_attn"))
+        if attn_remat:
+            from ..distributed.fleet.utils.recompute import recompute
+
+            def attn_only(h):
+                out, _ = self.self_attn(h, position_offset, None)
+                return out
+
+            attn_out = recompute(attn_only, self.input_layernorm(hidden))
+        else:
+            attn_out, cache = self.self_attn(
+                self.input_layernorm(hidden), position_offset, cache)
         hidden = residual + attn_out
         hidden = _mark_hidden(hidden, self.config)
         hidden = hidden + self.mlp(self.post_attention_layernorm(hidden))
@@ -304,9 +319,25 @@ class LlamaModel(Layer):
         hidden = self.embed_tokens(input_ids)
         hidden = _mark_hidden(hidden, self.config)
         new_caches = [] if caches is not None else None
+        gran = self.config.recompute_granularity
+        if self.config.use_recompute and gran not in (
+            "full", "full_attn", "core_attn", "selective",
+        ):
+            raise ValueError(
+                f"recompute_granularity must be one of full/full_attn/"
+                f"core_attn/selective, got {gran!r}"
+            )
         for i, layer in enumerate(self.layers):
             cache_i = caches[i] if caches is not None else None
-            if self.config.use_recompute and caches is None:
+            do_remat = (self.config.use_recompute and caches is None
+                        and gran in ("full", "selective"))
+            if do_remat and gran == "selective":
+                # every-other-layer full remat: ~half the activation
+                # memory for half of "full"'s recompute FLOPs (this
+                # framework's extension; PaddleNLP granularities are
+                # full/full_attn/core_attn)
+                do_remat = (i % 2 == 0)
+            if do_remat:
                 from ..distributed.fleet.utils.recompute import recompute
 
                 hidden = recompute(layer.forward_no_cache, hidden,

@@ -334,29 +334,47 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    """``moment_dtype="bfloat16"`` stores both moments in bf16 (HBM halved
+    for optimizer state — on one 16G v5e chip this is what lets a ~1B
+    model train WITHOUT activation recompute; the update math stays f32)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, name=None, **kwargs):
+                 use_multi_tensor=False, moment_dtype="float32", name=None,
+                 **kwargs):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        md = jnp.dtype(
+            jnp.bfloat16 if moment_dtype in ("bf16",) else moment_dtype
+        )
+        if md not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+            raise ValueError(
+                f"moment_dtype must be float32 or bfloat16, got {moment_dtype!r}"
+            )
+        self._moment_dtype = md
 
     def _init_state(self, p_value):
         return {
-            "moment1": jnp.zeros(p_value.shape, jnp.float32),
-            "moment2": jnp.zeros(p_value.shape, jnp.float32),
+            "moment1": jnp.zeros(p_value.shape, self._moment_dtype),
+            "moment2": jnp.zeros(p_value.shape, self._moment_dtype),
         }
 
     def _update(self, p, g, state, lr, step, decay=True):
         g32 = g.astype(jnp.float32)
-        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
-        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        m = (self._beta1 * state["moment1"].astype(jnp.float32)
+             + (1 - self._beta1) * g32)
+        v = (self._beta2 * state["moment2"].astype(jnp.float32)
+             + (1 - self._beta2) * jnp.square(g32))
         t = step.astype(jnp.float32)
         m_hat = m / (1 - self._beta1**t)
         v_hat = v / (1 - self._beta2**t)
         new_p = p.astype(jnp.float32) - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
-        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+        md = self._moment_dtype
+        return new_p.astype(p.dtype), {
+            "moment1": m.astype(md), "moment2": v.astype(md),
+        }
 
 
 class AdamW(Adam):
@@ -365,9 +383,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None, **kwargs):
+                 lazy_mode=False, multi_precision=False,
+                 moment_dtype="float32", name=None, **kwargs):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, lazy_mode, multi_precision)
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         moment_dtype=moment_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decay_enabled(self, param) -> bool:

@@ -143,3 +143,35 @@ def test_graft_entry_contract():
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (2, 128, 1024)
+
+
+@pytest.mark.parametrize("gran", ["full", "full_attn", "core_attn",
+                                  "selective"])
+def test_recompute_granularities_match_plain(gran):
+    mesh_state.set_mesh(None)
+
+    def losses(use_recompute):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(
+            tensor_parallel=False, use_recompute=use_recompute,
+            recompute_granularity=gran,
+        )
+        m = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = JittedTrainStep(m, lambda o, l: crit(o, l), opt)
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 128, (2, 32)))
+        return [float(step(ids, ids)) for _ in range(2)]
+
+    np.testing.assert_allclose(losses(True), losses(False),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_bad_recompute_granularity_raises():
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_recompute=True,
+                           recompute_granularity="bogus")
+    m = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.zeros((1, 8), "int32"))
+    with pytest.raises(ValueError, match="recompute_granularity"):
+        m(ids)

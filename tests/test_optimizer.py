@@ -157,3 +157,35 @@ def test_master_weights_multi_precision():
     st = opt._states[id(p)]
     assert "master" in st and str(st["master"].dtype) == "float32"
     assert p.dtype.name == "float16"
+
+
+def test_adam_bf16_moments_close_to_f32():
+    """moment_dtype='bfloat16' halves state HBM; updates stay f32-math
+    and track the f32-moment trajectory closely."""
+    import paddle_tpu.nn as nn
+
+    def train(moment_dtype, steps=20):
+        paddle.seed(0)
+        m = nn.Linear(16, 16)
+        opt = paddle.optimizer.AdamW(
+            1e-2, parameters=m.parameters(), moment_dtype=moment_dtype)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype("f4"))
+        losses = []
+        for _ in range(steps):
+            loss = ((m(x) - x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses, opt
+
+    l32, o32 = train("float32")
+    l16, o16 = train("bfloat16")
+    # state dtype actually halved
+    st = next(iter(o16._states.values()))
+    assert str(st["moment1"].dtype) == "bfloat16"
+    assert str(next(iter(o32._states.values()))["moment1"].dtype) == "float32"
+    # loss curves agree to bf16 tolerance and both decrease
+    assert l16[-1] < l16[0] and l32[-1] < l32[0]
+    np.testing.assert_allclose(l16, l32, rtol=0.05, atol=1e-3)
