@@ -1,0 +1,116 @@
+// paddle_tpu native io core (reference analog: the C++ DataLoader
+// workers + LoDTensorBlockingQueue machinery under paddle/fluid/operators/
+// reader/ — unverified, SURVEY.md §0).
+//
+// TPU-first division of labor: XLA owns device compute; the host-side
+// hot loops the GIL would serialize live here —
+//   * gather_rows: multithreaded batch assembly (row gather → one
+//     contiguous buffer ready for jax.device_put; H2D wants contiguity)
+//   * shuffle_indices: Fisher–Yates over an int64 index buffer with a
+//     splitmix64 stream (epoch shuffles of 100M-sample datasets)
+//   * pack_varlen: pad/pack variable-length token id rows into a dense
+//     int32 batch + lengths (NLP loader hot path)
+//
+// Plain C ABI (ctypes-loadable), C++17, no deps beyond pthread.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Copy rows `indices[0..n_idx)` of `src` (row_bytes each) into `dst`
+// contiguously, splitting the index range over `n_threads` workers.
+// Returns 0 on success, -1 on bad args.
+int ptpu_gather_rows(const uint8_t* src, int64_t n_rows, int64_t row_bytes,
+                     const int64_t* indices, int64_t n_idx, uint8_t* dst,
+                     int n_threads) {
+  if (!src || !dst || !indices || row_bytes <= 0 || n_idx < 0) return -1;
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int> bad{0};
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t r = indices[i];
+      if (r < 0 || r >= n_rows) {
+        bad.store(1, std::memory_order_relaxed);
+        return;
+      }
+      std::memcpy(dst + i * row_bytes, src + r * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+  if (n_threads == 1 || n_idx < 4 * n_threads) {
+    worker(0, n_idx);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = lo + chunk > n_idx ? n_idx : lo + chunk;
+      if (lo >= hi) break;
+      ts.emplace_back(worker, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  return bad.load() ? -1 : 0;
+}
+
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+// In-place Fisher–Yates over buf[0..n). Deterministic in `seed`.
+void ptpu_shuffle_indices(int64_t* buf, int64_t n, uint64_t seed) {
+  uint64_t s = seed ? seed : 0x853c49e6748fea9bull;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = splitmix64(&s) % static_cast<uint64_t>(i + 1);
+    int64_t tmp = buf[i];
+    buf[i] = buf[static_cast<int64_t>(j)];
+    buf[static_cast<int64_t>(j)] = tmp;
+  }
+}
+
+// Pack `n_rows` variable-length int32 rows (concatenated in `flat`,
+// row i spanning offsets[i]..offsets[i+1]) into dst[n_rows, max_len]
+// padded with pad_id; writes each row's length into lengths. Rows longer
+// than max_len are truncated. Returns 0, or -1 on bad args.
+int ptpu_pack_varlen(const int32_t* flat, const int64_t* offsets,
+                     int64_t n_rows, int64_t max_len, int32_t pad_id,
+                     int32_t* dst, int32_t* lengths, int n_threads) {
+  if (!flat || !offsets || !dst || !lengths || max_len <= 0) return -1;
+  if (n_threads < 1) n_threads = 1;
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t start = offsets[i], stop = offsets[i + 1];
+      int64_t len = stop - start;
+      if (len > max_len) len = max_len;
+      lengths[i] = static_cast<int32_t>(len);
+      int32_t* row = dst + i * max_len;
+      std::memcpy(row, flat + start, static_cast<size_t>(len) * 4);
+      for (int64_t j = len; j < max_len; ++j) row[j] = pad_id;
+    }
+  };
+  if (n_threads == 1 || n_rows < 4 * n_threads) {
+    worker(0, n_rows);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t chunk = (n_rows + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = lo + chunk > n_rows ? n_rows : lo + chunk;
+      if (lo >= hi) break;
+      ts.emplace_back(worker, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  return 0;
+}
+
+int ptpu_version() { return 1; }
+
+}  // extern "C"

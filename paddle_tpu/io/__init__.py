@@ -23,8 +23,17 @@ __all__ = [
     "ChainDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader",
-    "get_worker_info", "default_collate_fn",
+    "get_worker_info", "default_collate_fn", "pack_varlen",
 ]
+
+
+def pack_varlen(rows, max_len, pad_id=0):
+    """Pad/pack variable-length int sequences into a dense int32 batch +
+    lengths (native multithreaded kernel when csrc/ is built)."""
+    from . import _native
+
+    out, lengths = _native.pack_varlen(rows, max_len, pad_id)
+    return Tensor(out), Tensor(lengths)
 
 
 class Dataset:
@@ -140,6 +149,16 @@ class RandomSampler(Sampler):
         n = len(self.data_source)
         if self.replacement:
             return iter(np.random.randint(0, n, self.num_samples).tolist())
+        if n >= (1 << 16):
+            # epoch shuffles of large datasets: native Fisher–Yates
+            # (csrc/), seeded from the same global stream so runs stay
+            # reproducible under paddle.seed
+            from . import _native
+
+            seed = int(np.random.randint(0, 2**31 - 1))
+            return iter(
+                _native.shuffle_indices(n, seed)[: self.num_samples].tolist()
+            )
         return iter(np.random.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
@@ -410,7 +429,32 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
+    def _native_batch_iter(self):
+        """Native fast path: TensorDataset over numpy arrays + default
+        collate → per-field multithreaded row gather in C++ (csrc/),
+        yielding device-ready contiguous batches."""
+        from . import _native
+
+        fields = self.dataset.tensors
+        for batch_idx in self.batch_sampler:
+            idx = np.asarray(list(batch_idx), np.int64)
+            yield [Tensor(_native.gather_rows(t, idx)) for t in fields]
+
+    def _use_native_fast_path(self):
+        from . import _native
+
+        return (
+            isinstance(self.dataset, TensorDataset)
+            and self.collate_fn is default_collate_fn
+            and bool(self.dataset.tensors)
+            and all(isinstance(t, np.ndarray) for t in self.dataset.tensors)
+            and _native.lib() is not None
+        )
+
     def _fetch_iter(self):
+        if not self._iterable_mode and self._use_native_fast_path():
+            yield from self._native_batch_iter()
+            return
         if self._iterable_mode:
             buf = []
             for item in self.dataset:

@@ -1,0 +1,161 @@
+"""ctypes loader for the native io core (csrc/paddle_tpu_io.cc).
+
+Resolution order: a prebuilt ``libpaddle_tpu_io.so`` next to this file,
+then a cached build under ``~/.cache/paddle_tpu``, then a one-shot g++
+compile of ``csrc/`` when a toolchain is present (dev checkouts). All
+failures degrade to ``lib() is None`` — pure-Python paths keep working.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _candidate_paths():
+    here = os.path.dirname(os.path.abspath(__file__))
+    yield os.path.join(here, "libpaddle_tpu_io.so")
+    yield os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu",
+        "libpaddle_tpu_io.so",
+    )
+
+
+def _source_path():
+    # dev checkout: csrc/ sits two levels above paddle_tpu/io/
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p = os.path.join(root, "csrc", "paddle_tpu_io.cc")
+    return p if os.path.exists(p) else None
+
+
+def _try_build(out_path):
+    src = _source_path()
+    if src is None:
+        return None
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        src, "-o", out_path,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return out_path
+    except Exception as e:  # no toolchain / failed compile → Python path
+        print(f"paddle_tpu: native io build skipped ({e})", file=sys.stderr)
+        return None
+
+
+def _bind(path):
+    lib = ctypes.CDLL(path)
+    lib.ptpu_gather_rows.restype = ctypes.c_int
+    lib.ptpu_gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.ptpu_shuffle_indices.restype = None
+    lib.ptpu_shuffle_indices.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+    ]
+    lib.ptpu_pack_varlen.restype = ctypes.c_int
+    lib.ptpu_pack_varlen.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.ptpu_version.restype = ctypes.c_int
+    if lib.ptpu_version() != 1:
+        raise RuntimeError("native io core ABI mismatch")
+    return lib
+
+
+def lib():
+    """The loaded native library, or None (pure-Python fallback)."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    for path in _candidate_paths():
+        if os.path.exists(path):
+            try:
+                _LIB = _bind(path)
+                return _LIB
+            except Exception:
+                continue
+    built = _try_build(list(_candidate_paths())[-1])
+    if built:
+        try:
+            _LIB = _bind(built)
+        except Exception:
+            _LIB = None
+    return _LIB
+
+
+def _n_threads():
+    return min(8, os.cpu_count() or 1)
+
+
+def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Native batch assembly: ``src[indices]`` for a C-contiguous array,
+    multithreaded row memcpy. Falls back to numpy fancy indexing."""
+    L = lib()
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    if L is None or not src.flags.c_contiguous or src.ndim < 1:
+        return src[idx]
+    row_bytes = int(src.dtype.itemsize * np.prod(src.shape[1:], dtype=np.int64))
+    if row_bytes == 0:
+        return src[idx]
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    rc = L.ptpu_gather_rows(
+        src.ctypes.data, src.shape[0], row_bytes,
+        idx.ctypes.data, len(idx), out.ctypes.data, _n_threads(),
+    )
+    if rc != 0:
+        raise IndexError("gather_rows: index out of range")
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic native Fisher–Yates permutation of arange(n)."""
+    buf = np.arange(n, dtype=np.int64)
+    L = lib()
+    if L is None:
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        rng.shuffle(buf)
+        return buf
+    L.ptpu_shuffle_indices(buf.ctypes.data, n, seed)
+    return buf
+
+
+def pack_varlen(rows, max_len: int, pad_id: int = 0):
+    """Pack a list of int sequences → (batch int32 [n, max_len], lengths
+    int32 [n]); truncates rows longer than max_len."""
+    out = np.empty((len(rows), max_len), np.int32)
+    lengths = np.empty((len(rows),), np.int32)
+    L = lib()
+    if L is None:
+        for i, r in enumerate(rows):
+            a = np.asarray(r, dtype=np.int32)[:max_len]
+            lengths[i] = len(a)
+            out[i, : len(a)] = a
+            out[i, len(a):] = pad_id
+        return out, lengths
+    flat = np.concatenate(
+        [np.asarray(r, dtype=np.int32) for r in rows]
+    ) if rows else np.zeros((0,), np.int32)
+    offsets = np.zeros(len(rows) + 1, np.int64)
+    np.cumsum([len(r) for r in rows], out=offsets[1:])
+    rc = L.ptpu_pack_varlen(
+        flat.ctypes.data, offsets.ctypes.data, len(rows), max_len,
+        pad_id, out.ctypes.data, lengths.ctypes.data, _n_threads(),
+    )
+    if rc != 0:
+        raise ValueError("pack_varlen: bad arguments")
+    return out, lengths

@@ -177,6 +177,11 @@ class LlamaAttention(Layer):
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.o_proj(out), cache
 
+    def forward_no_cache(self, hidden, position_offset=0):
+        """Single-output variant for the remat wrapper (core_attn)."""
+        out, _ = self.forward(hidden, position_offset, None)
+        return out
+
     def _update_cache(self, k, v, cache, position_offset):
         import jax
 
@@ -275,11 +280,13 @@ class LlamaDecoderLayer(Layer):
         if attn_remat:
             from ..distributed.fleet.utils.recompute import recompute
 
-            def attn_only(h):
-                out, _ = self.self_attn(h, position_offset, None)
-                return out
-
-            attn_out = recompute(attn_only, self.input_layernorm(hidden))
+            # bound method of the attention Layer: recompute() registers
+            # its params as differentiable inputs (a bare closure would
+            # silently freeze q/k/v/o in eager training)
+            attn_out = recompute(
+                self.self_attn.forward_no_cache,
+                self.input_layernorm(hidden), position_offset,
+            )
         else:
             attn_out, cache = self.self_attn(
                 self.input_layernorm(hidden), position_offset, cache)

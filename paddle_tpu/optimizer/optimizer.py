@@ -346,9 +346,12 @@ class Adam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
-        md = jnp.dtype(
-            jnp.bfloat16 if moment_dtype in ("bf16",) else moment_dtype
-        )
+        try:
+            md = jnp.dtype(
+                jnp.bfloat16 if moment_dtype in ("bf16",) else moment_dtype
+            )
+        except TypeError:
+            md = None
         if md not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
             raise ValueError(
                 f"moment_dtype must be float32 or bfloat16, got {moment_dtype!r}"

@@ -175,3 +175,19 @@ def test_bad_recompute_granularity_raises():
     ids = paddle.to_tensor(np.zeros((1, 8), "int32"))
     with pytest.raises(ValueError, match="recompute_granularity"):
         m(ids)
+
+
+def test_core_attn_remat_eager_grads_flow():
+    """Regression: attention-only remat must register attention params
+    with the tape in eager mode (bare-closure recompute froze them)."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_recompute=True,
+                           recompute_granularity="core_attn")
+    m = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 128, (2, 16)))
+    loss = crit(m(ids), ids)
+    loss.backward()
+    q = m.llama.layers[0].self_attn.q_proj.weight
+    assert q.grad is not None
+    assert float(np.abs(np.asarray(q.grad._value)).sum()) > 0
