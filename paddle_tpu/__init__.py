@@ -107,3 +107,4 @@ from . import profiler  # noqa: E402
 from . import fft  # noqa: E402
 from . import quantization  # noqa: E402
 from . import sparse  # noqa: E402
+from . import device  # noqa: E402

@@ -1,0 +1,166 @@
+"""paddle.device — device introspection + memory stats (reference:
+python/paddle/device/__init__.py and device/cuda/ — unverified,
+SURVEY.md §0; round-1 verdict L2 row: "no device-introspection/
+memory-stats surface").
+
+TPU mapping: the reference's per-allocator CUDA counters map to PJRT's
+``device.memory_stats()`` (bytes_in_use / peak_bytes_in_use /
+bytes_limit). The ``cuda`` submodule alias keeps reference call sites
+(``paddle.device.cuda.max_memory_allocated()``) working against the
+accelerator actually present. Streams are XLA's concern: ``synchronize``
+is a barrier on all in-flight computations, and Stream/Event are no-op
+ordering facades (everything on one device is already ordered)."""
+from __future__ import annotations
+
+import types
+
+import jax
+
+from ..core.place import (  # noqa: F401
+    set_device, get_device, current_place, CPUPlace, CUDAPlace, TPUPlace,
+)
+
+__all__ = [
+    "set_device", "get_device", "get_all_device_type",
+    "get_available_device", "device_count", "synchronize",
+    "memory_allocated", "max_memory_allocated", "memory_reserved",
+    "max_memory_reserved", "empty_cache", "get_device_properties",
+    "cuda", "Stream", "Event",
+]
+
+
+def _devices():
+    return jax.devices()
+
+
+def _resolve_id(device):
+    """paddle device arg → device index: int | 'tpu:1' | 'gpu:0' | Place
+    | None (current)."""
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    did = getattr(device, "device_id", None)  # Place
+    if did is not None:
+        return int(did)
+    name = str(device)
+    if ":" in name:
+        return int(name.rsplit(":", 1)[1])
+    return 0
+
+
+def _device(device=None):
+    devs = _devices()
+    return devs[_resolve_id(device)]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in _devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in _devices()]
+
+
+def device_count(device_type=None):
+    if device_type is None:
+        return len(_devices())
+    return sum(1 for d in _devices() if d.platform == str(device_type))
+
+
+def synchronize(device=None):
+    """Block until all in-flight computations on ``device`` finish."""
+    (jax.device_put(0.0, _device(device)) + 0).block_until_ready()
+
+
+def _stats(device=None):
+    d = _device(device)
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    """Live bytes on the device (PJRT bytes_in_use); 0 when the backend
+    doesn't report (CPU, tunneled TPU)."""
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def empty_cache():
+    """XLA owns the allocator; nothing to flush (API-parity no-op)."""
+    return None
+
+
+def get_device_properties(device=None):
+    d = _device(device)
+    s = _stats(device)
+    return types.SimpleNamespace(
+        name=d.device_kind,
+        total_memory=int(s.get("bytes_limit", 0)),
+        major=0, minor=0,
+        multi_processor_count=len(_devices()),
+    )
+
+
+class Stream:
+    """Ordering facade: XLA serializes per-device execution, so a stream
+    is just a handle (reference paddle.device.Stream parity)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        return None
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+# reference spelling: paddle.device.cuda.* — same accelerator underneath
+cuda = types.SimpleNamespace(
+    device_count=device_count,
+    memory_allocated=memory_allocated,
+    max_memory_allocated=max_memory_allocated,
+    memory_reserved=memory_reserved,
+    max_memory_reserved=max_memory_reserved,
+    empty_cache=empty_cache,
+    synchronize=synchronize,
+    get_device_properties=get_device_properties,
+    Stream=Stream,
+    Event=Event,
+)

@@ -24,6 +24,8 @@ from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
+from . import extras  # noqa: F401
 
 
 # --------------------------------------------------------------------------
@@ -131,8 +133,9 @@ Tensor.__itruediv__ = _iop(math.divide)
 # --------------------------------------------------------------------------
 # Method patching
 # --------------------------------------------------------------------------
-_METHOD_SOURCES = [math, creation, manipulation, linalg, logic, random, search, stat]
+_METHOD_SOURCES = [math, creation, manipulation, linalg, logic, random, search, stat, extras]
 _SKIP = {"to_tensor", "is_tensor", "meshgrid", "tril_indices", "triu_indices",
+         "broadcast_shape", "add_n", "shape", "rank",
          "rand", "randn", "randint", "uniform", "normal", "randperm", "arange",
          "linspace", "logspace", "eye", "zeros", "ones", "full", "empty",
          "complex", "polar", "assign", "broadcast_tensors"}

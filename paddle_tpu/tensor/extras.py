@@ -1,0 +1,305 @@
+"""Op-corpus extensions (round-2 breadth pass): the remaining reference
+top-level tensor ops (reference: python/paddle/tensor/{math,
+manipulation,creation,attribute}.py — unverified, SURVEY.md §0) plus the
+last linalg rows (cond/lu_unpack/householder_product/matrix_exp)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, apply, ensure_tensor, axes_arg
+
+__all__ = [
+    "add_n", "broadcast_shape", "diag_embed", "dsplit", "hsplit", "vsplit",
+    "i1", "index_fill", "inverse", "is_complex", "is_floating_point",
+    "logcumsumexp", "masked_scatter", "rank", "renorm", "sgn", "shape",
+    "signbit", "tensordot", "trace", "unflatten", "vander",
+    "cond", "lu_unpack", "householder_product", "matrix_exp",
+]
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    ts = [ensure_tensor(t) for t in inputs]
+    return apply(lambda *vs: sum(vs[1:], vs[0]), *ts, op_name="add_n")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(input)
+
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        out_ndim = v.ndim + 1
+        d1 = dim1 % out_ndim
+        d2 = dim2 % out_ndim
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        base = base.at[..., rows, cols].set(v)
+        # base has the two diag dims last; move them to (dim1, dim2)
+        order = list(range(out_ndim - 2))
+        src1, src2 = out_ndim - 2, out_ndim - 1
+        perm = [None] * out_ndim
+        perm[d1], perm[d2] = src1, src2
+        it = iter(order)
+        for i in range(out_ndim):
+            if perm[i] is None:
+                perm[i] = next(it)
+        return jnp.transpose(base, perm)
+
+    return apply(fn, x, op_name="diag_embed")
+
+
+def _split_along(x, num_or_indices, axis, name):
+    from .manipulation import split
+
+    if isinstance(num_or_indices, (list, tuple)):
+        # numpy/paddle h/v/dsplit semantics: a list holds split INDICES;
+        # convert to the section sizes split() expects
+        dim = x.shape[axis]
+        bounds = [0] + [int(i) for i in num_or_indices] + [dim]
+        sections = [b - a for a, b in zip(bounds, bounds[1:])]
+        return split(x, sections, axis=axis, name=name)
+    return split(x, num_or_indices, axis=axis, name=name)
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    axis = 0 if x.ndim == 1 else 1
+    return _split_along(x, num_or_indices, axis, name)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_along(ensure_tensor(x), num_or_indices, 0, name)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_along(ensure_tensor(x), num_or_indices, 2, name)
+
+
+def i1(x, name=None):
+    return apply(jax.scipy.special.i1, ensure_tensor(x), op_name="i1")
+
+
+def index_fill(x, index, axis, value, name=None):
+    x = ensure_tensor(x)
+    index = ensure_tensor(index)
+
+    def fn(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply(fn, x, index, op_name="index_fill")
+
+
+def inverse(x, name=None):
+    from .linalg import inv  # single implementation lives in linalg
+
+    return inv(x, name=name)
+
+
+def is_complex(x):
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.floating)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        w = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, w, axis=ax)
+
+    return apply(fn, x, op_name="logcumsumexp")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of ``mask`` with consecutive ``value`` items."""
+    x = ensure_tensor(x)
+    mask = ensure_tensor(mask)
+    value = ensure_tensor(value)
+    if not isinstance(mask._value, jax.core.Tracer):
+        need = int(jnp.broadcast_to(mask._value, x._value.shape).sum())
+        if value._value.size < need:
+            raise ValueError(
+                f"masked_scatter: mask selects {need} elements but value "
+                f"has only {value._value.size}"
+            )
+
+    def fn(v, m, val):
+        m = jnp.broadcast_to(m, v.shape)
+        k = jnp.cumsum(m.reshape(-1)) - 1
+        src = val.reshape(-1)[jnp.clip(k, 0, val.size - 1)].reshape(v.shape)
+        return jnp.where(m, src.astype(v.dtype), v)
+
+    return apply(fn, x, mask, value, op_name="masked_scatter")
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(input).ndim, jnp.int32))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat.astype(jnp.float32), ord=p, axis=1)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None].astype(v.dtype)
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply(fn, x, op_name="renorm")
+
+
+def sgn(x, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return apply(fn, x, op_name="sgn")
+
+
+def shape(input, name=None):
+    """1-D int32 tensor holding the runtime shape (reference
+    paddle.shape)."""
+    return Tensor(jnp.asarray(ensure_tensor(input)._value.shape, jnp.int32))
+
+
+def signbit(x, name=None):
+    return apply(jnp.signbit, ensure_tensor(x), op_name="signbit")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axes
+    if isinstance(axes, Tensor):
+        ax = axes.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(
+            tuple(a) if isinstance(a, (list, tuple)) else a for a in ax
+        )
+    return apply(
+        lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, op_name="tensordot"
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+        ensure_tensor(x), op_name="trace",
+    )
+
+
+def unflatten(x, axis, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+
+    def fn(v):
+        ax = axis % v.ndim
+        new = list(v.shape[:ax]) + list(shape) + list(v.shape[ax + 1:])
+        return v.reshape(new)
+
+    return apply(fn, x, op_name="unflatten")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = ensure_tensor(x)
+    cols = n if n is not None else x.shape[0]
+
+    def fn(v):
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :].astype(v.dtype)
+
+    return apply(fn, x, op_name="vander")
+
+
+# -- linalg tail ---------------------------------------------------------
+
+def cond(x, p=None, name=None):
+    x = ensure_tensor(x)
+    ord_ = 2 if p is None else p
+    return apply(
+        lambda v: jnp.linalg.cond(v, p=ord_), x, op_name="linalg_cond"
+    )
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(LU packed, pivots) → (P, L, U) (reference paddle.linalg.lu_unpack;
+    pivots are 1-indexed sequential row swaps, as paddle.linalg.lu
+    emits)."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+
+    def core(lu, piv):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu[:, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[:k, :])
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(m, dtype=lu.dtype)[perm].T
+        return P, L, U
+
+    def fn(lu, piv):
+        f = core
+        for _ in range(lu.ndim - 2):  # map any leading batch dims
+            f = jax.vmap(f)
+        return f(lu, piv)
+
+    return apply(fn, x, y, op_name="lu_unpack")
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (geqrf layout): Q = H_0 H_1 ... ."""
+    x = ensure_tensor(x)
+    tau = ensure_tensor(tau)
+
+    def core(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = a[:, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            q = q @ h
+        return q[:, :n]
+
+    def fn(a, t):
+        f = core
+        for _ in range(a.ndim - 2):  # map any leading batch dims
+            f = jax.vmap(f)
+        return f(a, t)
+
+    return apply(fn, x, tau, op_name="householder_product")
+
+
+def matrix_exp(x, name=None):
+    return apply(
+        jax.scipy.linalg.expm, ensure_tensor(x), op_name="matrix_exp"
+    )

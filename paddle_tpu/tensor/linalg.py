@@ -308,3 +308,11 @@ def einsum(equation, *operands):
     return apply(
         lambda *vs: jnp.einsum(equation, *vs), *ts, op_name="einsum"
     )
+
+
+# linalg tail ops live in extras.py (round-2 breadth pass)
+from .extras import (  # noqa: E402,F401
+    cond, lu_unpack, householder_product, matrix_exp, inverse,
+)
+__all__ += ["cond", "lu_unpack", "householder_product", "matrix_exp",
+            "inverse"]

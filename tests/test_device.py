@@ -1,0 +1,32 @@
+"""paddle.device introspection + memory stats (round-1 verdict L2 row)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_device_enumeration():
+    devs = paddle.device.get_available_device()
+    assert len(devs) == paddle.device.device_count() > 0
+    assert paddle.device.get_all_device_type()
+
+
+def test_memory_stats_are_ints():
+    x = paddle.to_tensor(np.zeros((256, 256), "f4"))
+    a = paddle.device.memory_allocated()
+    m = paddle.device.max_memory_allocated()
+    assert isinstance(a, int) and isinstance(m, int) and m >= a >= 0
+
+
+def test_cuda_alias_and_properties():
+    assert paddle.device.cuda.device_count() == paddle.device.device_count()
+    props = paddle.device.get_device_properties()
+    assert props.name
+    paddle.device.cuda.empty_cache()
+
+
+def test_synchronize_and_stream_facades():
+    paddle.device.synchronize()
+    s = paddle.device.Stream()
+    e = s.record_event()
+    assert e.query()
+    e.synchronize()

@@ -1,0 +1,212 @@
+"""Round-2 op-corpus breadth: remaining reference top-level ops + linalg
+tail, numpy-oracle checked."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_add_n():
+    xs = [np.random.RandomState(i).randn(3, 4).astype("f4") for i in range(3)]
+    out = paddle.add_n([_t(x) for x in xs])
+    np.testing.assert_allclose(np.asarray(out._value), sum(xs), rtol=1e-6)
+
+
+def test_broadcast_shape():
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_diag_embed():
+    v = np.arange(6, dtype="f4").reshape(2, 3)
+    out = np.asarray(paddle.diag_embed(_t(v))._value)
+    for b in range(2):
+        np.testing.assert_allclose(out[b], np.diag(v[b]))
+    off = np.asarray(paddle.diag_embed(_t(v), offset=1)._value)
+    assert off.shape == (2, 4, 4)
+    np.testing.assert_allclose(off[0], np.diag(v[0], k=1))
+
+
+def test_splits():
+    x = np.arange(24, dtype="f4").reshape(2, 6, 2)
+    hs = paddle.hsplit(_t(x), 3)
+    np.testing.assert_allclose(np.asarray(hs[1]._value), x[:, 2:4, :])
+    vs = paddle.vsplit(_t(x), 2)
+    np.testing.assert_allclose(np.asarray(vs[0]._value), x[:1])
+    ds = paddle.dsplit(_t(x), 2)
+    np.testing.assert_allclose(np.asarray(ds[1]._value), x[..., 1:])
+
+
+def test_bessel_i1():
+    from scipy.special import i1 as scipy_i1
+
+    x = np.linspace(0, 3, 16).astype("f4")
+    np.testing.assert_allclose(
+        np.asarray(paddle.i1(_t(x))._value), scipy_i1(x), rtol=1e-4
+    )
+
+
+def test_index_fill_and_masked_scatter():
+    x = np.zeros((3, 4), "f4")
+    out = paddle.index_fill(_t(x), _t(np.array([0, 2])), 0, 7.0)
+    expect = x.copy()
+    expect[[0, 2]] = 7.0
+    np.testing.assert_allclose(np.asarray(out._value), expect)
+
+    mask = np.array([[True, False], [False, True]])
+    vals = np.array([10.0, 20.0, 30.0], "f4")
+    out = paddle.masked_scatter(_t(np.zeros((2, 2), "f4")), _t(mask), _t(vals))
+    np.testing.assert_allclose(
+        np.asarray(out._value), [[10.0, 0.0], [0.0, 20.0]]
+    )
+
+
+def test_inverse_and_dtype_predicates():
+    a = np.array([[2.0, 0.0], [1.0, 3.0]], "f4")
+    np.testing.assert_allclose(
+        np.asarray(paddle.inverse(_t(a))._value), np.linalg.inv(a), rtol=1e-5
+    )
+    assert paddle.is_floating_point(_t(a))
+    assert not paddle.is_complex(_t(a))
+
+
+def test_logcumsumexp():
+    x = np.random.RandomState(0).randn(5, 4).astype("f4")
+    out = np.asarray(paddle.logcumsumexp(_t(x), axis=1)._value)
+    expect = np.logaddexp.accumulate(x, axis=1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_rank_shape_signbit_sgn():
+    x = np.zeros((2, 3, 4), "f4")
+    assert int(paddle.rank(_t(x))) == 3
+    np.testing.assert_array_equal(
+        np.asarray(paddle.shape(_t(x))._value), [2, 3, 4]
+    )
+    v = np.array([-1.5, 0.0, 2.0], "f4")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.signbit(_t(v))._value), np.signbit(v)
+    )
+    np.testing.assert_allclose(
+        np.asarray(paddle.sgn(_t(v))._value), np.sign(v)
+    )
+
+
+def test_renorm():
+    x = np.random.RandomState(1).randn(4, 8).astype("f4")
+    out = np.asarray(paddle.renorm(_t(x), p=2.0, axis=0, max_norm=1.0)._value)
+    norms = np.linalg.norm(out, axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+    small = x / (np.linalg.norm(x, axis=1, keepdims=True) + 1) * 0.5
+    out2 = np.asarray(
+        paddle.renorm(_t(small.astype("f4")), 2.0, 0, 1.0)._value)
+    np.testing.assert_allclose(out2, small, rtol=1e-4)
+
+
+def test_tensordot_trace_unflatten_vander():
+    a = np.random.RandomState(2).randn(3, 4, 5).astype("f4")
+    b = np.random.RandomState(3).randn(4, 5, 6).astype("f4")
+    np.testing.assert_allclose(
+        np.asarray(paddle.tensordot(_t(a), _t(b), axes=2)._value),
+        np.tensordot(a, b, axes=2), rtol=1e-4, atol=1e-5,
+    )
+    m = np.arange(9, dtype="f4").reshape(3, 3)
+    assert float(paddle.trace(_t(m))) == np.trace(m)
+    u = paddle.unflatten(_t(np.zeros((2, 12), "f4")), 1, [3, 4])
+    assert u.shape == [2, 3, 4]
+    v = np.array([1.0, 2.0, 3.0], "f4")
+    np.testing.assert_allclose(
+        np.asarray(paddle.vander(_t(v))._value), np.vander(v), rtol=1e-6
+    )
+
+
+def test_linalg_cond_and_matrix_exp():
+    a = np.array([[3.0, 0.0], [0.0, 1.0]], "f4")
+    np.testing.assert_allclose(
+        float(paddle.linalg.cond(_t(a))), np.linalg.cond(a), rtol=1e-5
+    )
+    from scipy.linalg import expm
+
+    m = np.array([[0.0, 1.0], [-1.0, 0.0]], "f4")
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.matrix_exp(_t(m))._value), expm(m),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_lu_unpack_reconstructs():
+    rng = np.random.RandomState(4)
+    a = rng.randn(4, 4).astype("f4")
+    lu, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = np.asarray(P._value) @ np.asarray(L._value) @ np.asarray(U._value)
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_householder_product_matches_reflector_product():
+    rng = np.random.RandomState(5)
+    m, k = 4, 3
+    a = rng.randn(m, k).astype("f8")
+    tau = (rng.rand(k) * 0.5).astype("f8")
+    q_ref = np.eye(m)
+    for i in range(k):
+        v = a[:, i].copy()
+        v[:i] = 0.0
+        v[i] = 1.0
+        q_ref = q_ref @ (np.eye(m) - tau[i] * np.outer(v, v))
+    q = np.asarray(
+        paddle.linalg.householder_product(_t(a), _t(tau))._value
+    )
+    np.testing.assert_allclose(q, q_ref[:, :k], rtol=1e-5, atol=1e-6)
+
+
+def test_split_index_semantics():
+    x = np.arange(12, dtype="f4").reshape(2, 6)
+    parts = paddle.hsplit(_t(x), [2, 4])
+    assert [p.shape for p in parts] == [[2, 2], [2, 2], [2, 2]]
+    np.testing.assert_allclose(np.asarray(parts[1]._value), x[:, 2:4])
+    uneven = paddle.hsplit(_t(x), [1, 3])
+    assert [p.shape for p in uneven] == [[2, 1], [2, 2], [2, 3]]
+
+
+def test_masked_scatter_undersized_value_raises():
+    with pytest.raises(ValueError, match="masked_scatter"):
+        paddle.masked_scatter(
+            _t(np.zeros(5, "f4")), _t(np.ones(5, bool)),
+            _t(np.array([1.0, 2.0], "f4")),
+        )
+
+
+def test_lu_unpack_batched():
+    rng = np.random.RandomState(6)
+    a = rng.randn(3, 4, 4).astype("f4")
+    lu, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = (np.asarray(P._value) @ np.asarray(L._value)
+           @ np.asarray(U._value))
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_householder_product_batched():
+    rng = np.random.RandomState(7)
+    a = rng.randn(2, 4, 3).astype("f4")
+    tau = (rng.rand(2, 3) * 0.5).astype("f4")
+    q = np.asarray(paddle.linalg.householder_product(_t(a), _t(tau))._value)
+    assert q.shape == (2, 4, 3)
+    for b in range(2):
+        q_ref = np.eye(4)
+        for i in range(3):
+            v = a[b, :, i].astype("f8").copy()
+            v[:i] = 0.0
+            v[i] = 1.0
+            q_ref = q_ref @ (np.eye(4) - tau[b, i] * np.outer(v, v))
+        np.testing.assert_allclose(q[b], q_ref[:, :3], rtol=1e-4, atol=1e-5)
+
+
+def test_device_arg_accepted_by_memory_api():
+    assert paddle.device.memory_allocated(0) >= 0
+    assert paddle.device.memory_allocated("cpu:0") >= 0
+    paddle.device.synchronize(0)
