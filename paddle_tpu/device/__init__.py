@@ -125,7 +125,7 @@ class Stream:
         self.device = device
 
     def synchronize(self):
-        synchronize()
+        synchronize(self.device)
 
     def wait_event(self, event):
         return None
@@ -139,16 +139,17 @@ class Stream:
 
 class Event:
     def __init__(self, enable_timing=False, blocking=False, interprocess=False):
-        pass
+        self._stream = None
 
     def record(self, stream=None):
+        self._stream = stream
         return None
 
     def query(self):
         return True
 
     def synchronize(self):
-        synchronize()
+        synchronize(self._stream.device if self._stream else None)
 
 
 # reference spelling: paddle.device.cuda.* — same accelerator underneath

@@ -15,3 +15,8 @@ from .llama import (  # noqa: F401
     LlamaPretrainingCriterion,
 )
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining,
+    BertForSequenceClassification, BertPretrainingCriterion,
+    ErnieConfig, ErnieModel, ErnieForPretraining,
+)

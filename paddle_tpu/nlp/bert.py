@@ -1,0 +1,244 @@
+"""BERT / ERNIE model family (reference: PaddleNLP
+paddlenlp/transformers/{bert,ernie}/modeling.py — unverified, SURVEY.md
+§0; BASELINE.md config #4 is ERNIE-3.0 pretrain under auto-parallel).
+
+Built from the framework's own nn stack (TransformerEncoder / LayerNorm /
+Embedding), so the whole family inherits the jitted train-step, AMP,
+recompute, and sharding paths for free. ERNIE shares BERT's architecture
+(the differences that matter for pretraining are the masking strategy and
+embedding extras handled at data/config level), so ``ErnieModel`` is the
+same graph with ERNIE defaults and naming."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding, Dropout
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..nn import functional as F
+from ..tensor._helpers import apply, ensure_tensor
+
+__all__ = [
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertForSequenceClassification", "BertPretrainingCriterion",
+    "ErnieConfig", "ErnieModel", "ErnieForPretraining",
+]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 pad_token_id=0, num_labels=2):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+        self.num_labels = num_labels
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=2, intermediate_size=64,
+                   max_position_embeddings=64, hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0)
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import jax.numpy as jnp
+
+        input_ids = ensure_tensor(input_ids)
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = apply(
+                lambda ids: jnp.broadcast_to(jnp.arange(s), (b, s)),
+                input_ids, op_name="bert_position_ids",
+            )
+        if token_type_ids is None:
+            token_type_ids = apply(
+                lambda ids: jnp.zeros_like(ids), input_ids,
+                op_name="bert_token_type_ids",
+            )
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps,
+        )
+        self.encoder = TransformerEncoder(layer, config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        import jax.numpy as jnp
+
+        if attention_mask is not None:
+            attention_mask = ensure_tensor(attention_mask)
+            # (B, S) key padding mask → additive (B, 1, 1, S) logits bias
+            attention_mask = apply(
+                lambda m: jnp.where(
+                    m[:, None, None, :].astype(bool), 0.0, -1e9
+                ).astype(jnp.float32),
+                attention_mask, op_name="bert_attn_mask",
+            )
+        hidden = self.embeddings(input_ids, token_type_ids, position_ids)
+        hidden = self.encoder(hidden, attention_mask)
+        return hidden, self.pooler(hidden)
+
+
+class BertLMPredictionHead(Layer):
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    config.layer_norm_eps)
+        self._tied = embedding_weights  # (V, E) word embedding table
+        self.decoder_bias = self.create_parameter(
+            (config.vocab_size,), is_bias=True)
+        self._act = getattr(F, config.hidden_act)
+
+    def forward(self, hidden):
+        h = self.layer_norm(self._act(self.transform(hidden)))
+        return F.linear(h, self._tied.t()) + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        hidden, pooled = self.bert(
+            input_ids, token_type_ids, attention_mask=attention_mask)
+        return self.cls(hidden), self.nsp(pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    """Masked-LM + next-sentence loss; mlm positions marked by label
+    ``ignore_index`` (-100) are excluded."""
+
+    def __init__(self, vocab_size=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        import jax.numpy as jnp
+        import jax
+
+        scores = ensure_tensor(prediction_scores)
+        labels = ensure_tensor(masked_lm_labels)
+
+        def mlm(sc, lb):
+            logits = sc.reshape(-1, sc.shape[-1]).astype(jnp.float32)
+            lab = lb.reshape(-1)
+            valid = lab != self.ignore_index
+            safe = jnp.where(valid, lab, 0)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+            nll = jnp.where(valid, nll, 0.0)
+            return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+        loss = apply(mlm, scores, labels, op_name="mlm_loss")
+        if next_sentence_labels is not None:
+            nsp_logits = ensure_tensor(seq_relationship_score)
+            nsp_labels = ensure_tensor(next_sentence_labels)
+
+            def nsp(sc, lb):
+                logp = jax.nn.log_softmax(sc.astype(jnp.float32), axis=-1)
+                return -jnp.take_along_axis(
+                    logp, lb.reshape(-1, 1), axis=1
+                ).mean()
+
+            loss = loss + apply(nsp, nsp_logits, nsp_labels,
+                                op_name="nsp_loss")
+        return loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(
+            input_ids, token_type_ids, attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# -- ERNIE: same architecture, ERNIE defaults/naming ---------------------
+
+class ErnieConfig(BertConfig):
+    def __init__(self, vocab_size=40000, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu", **kw):
+        super().__init__(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_hidden_layers=num_hidden_layers,
+            num_attention_heads=num_attention_heads,
+            intermediate_size=intermediate_size, hidden_act=hidden_act, **kw)
+
+
+class ErnieModel(BertModel):
+    pass
+
+
+class ErnieForPretraining(BertForPretraining):
+    pass

@@ -117,8 +117,16 @@ def is_floating_point(x):
 
 def logcumsumexp(x, axis=None, dtype=None, name=None):
     x = ensure_tensor(x)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+
+        jdt = to_jax_dtype(dtype)
+    else:
+        jdt = None
 
     def fn(v):
+        if jdt is not None:
+            v = v.astype(jdt)  # accumulate in the requested precision
         w = v.reshape(-1) if axis is None else v
         ax = 0 if axis is None else axis
         return jax.lax.associative_scan(jnp.logaddexp, w, axis=ax)
@@ -190,13 +198,18 @@ def signbit(x, name=None):
 
 def tensordot(x, y, axes=2, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
-    ax = axes
-    if isinstance(axes, Tensor):
-        ax = axes.tolist()
+    ax = axes.tolist() if isinstance(axes, Tensor) else axes
     if isinstance(ax, (list, tuple)):
-        ax = tuple(
-            tuple(a) if isinstance(a, (list, tuple)) else a for a in ax
-        )
+        entries = [
+            list(a) if isinstance(a, (list, tuple)) else a for a in ax
+        ]
+        if all(isinstance(a, int) for a in entries):
+            # paddle: a flat int list applies to BOTH tensors
+            ax = (entries, entries)
+        elif len(entries) == 1:
+            ax = (entries[0], entries[0])  # single-list form
+        else:
+            ax = tuple(entries[:2])
     return apply(
         lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, op_name="tensordot"
     )
@@ -248,30 +261,44 @@ def cond(x, p=None, name=None):
 def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     """(LU packed, pivots) → (P, L, U) (reference paddle.linalg.lu_unpack;
     pivots are 1-indexed sequential row swaps, as paddle.linalg.lu
-    emits)."""
+    emits). Flags skip the corresponding outputs (returned as None)."""
     x = ensure_tensor(x)
     y = ensure_tensor(y)
 
-    def core(lu, piv):
+    def lu_core(lu):
         m, n = lu.shape[-2], lu.shape[-1]
         k = min(m, n)
         L = jnp.tril(lu[:, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
         U = jnp.triu(lu[:k, :])
+        return L, U
+
+    def piv_core(lu, piv):
+        m = lu.shape[-2]
         perm = jnp.arange(m)
         for i in range(piv.shape[-1]):
             j = piv[i] - 1
             pi, pj = perm[i], perm[j]
             perm = perm.at[i].set(pj).at[j].set(pi)
-        P = jnp.eye(m, dtype=lu.dtype)[perm].T
-        return P, L, U
+        return jnp.eye(m, dtype=lu.dtype)[perm].T
 
-    def fn(lu, piv):
-        f = core
-        for _ in range(lu.ndim - 2):  # map any leading batch dims
+    def _vmapped(f, ndim_extra):
+        for _ in range(ndim_extra):
             f = jax.vmap(f)
-        return f(lu, piv)
+        return f
 
-    return apply(fn, x, y, op_name="lu_unpack")
+    batch = x._value.ndim - 2
+    P = L = U = None
+    if unpack_pivots:
+        P = apply(
+            lambda lu, piv: _vmapped(piv_core, batch)(lu, piv), x, y,
+            op_name="lu_unpack_pivots",
+        )
+    if unpack_ludata:
+        L, U = apply(
+            lambda lu: _vmapped(lu_core, batch)(lu), x,
+            op_name="lu_unpack_data",
+        )
+    return P, L, U
 
 
 def householder_product(x, tau, name=None):
@@ -286,8 +313,8 @@ def householder_product(x, tau, name=None):
             v = a[:, i]
             v = jnp.where(jnp.arange(m) < i, 0.0, v)
             v = v.at[i].set(1.0)
-            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
-            q = q @ h
+            # rank-1 update: q @ (I - t v vᵀ) without the m×m temporary
+            q = q - t[i] * jnp.outer(q @ v, v)
         return q[:, :n]
 
     def fn(a, t):

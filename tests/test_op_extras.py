@@ -210,3 +210,35 @@ def test_device_arg_accepted_by_memory_api():
     assert paddle.device.memory_allocated(0) >= 0
     assert paddle.device.memory_allocated("cpu:0") >= 0
     paddle.device.synchronize(0)
+
+
+def test_tensordot_paddle_axes_forms():
+    x = np.random.RandomState(8).randn(3, 3, 5).astype("f4")
+    y = np.random.RandomState(9).randn(3, 3, 6).astype("f4")
+    expect = np.tensordot(x, y, axes=([0, 1], [0, 1]))
+    # flat int list applies to both tensors (paddle semantics)
+    np.testing.assert_allclose(
+        np.asarray(paddle.tensordot(_t(x), _t(y), axes=[0, 1])._value),
+        expect, rtol=1e-4, atol=1e-5)
+    # single-list form
+    np.testing.assert_allclose(
+        np.asarray(paddle.tensordot(_t(x), _t(y), axes=[[0, 1]])._value),
+        expect, rtol=1e-4, atol=1e-5)
+
+
+def test_logcumsumexp_dtype_honored():
+    # bf16 input accumulated in f32 (float64 stays capped by jax's x64
+    # default — f32 accumulation is the case that matters on TPU)
+    x = paddle.to_tensor(
+        np.random.RandomState(10).randn(8).astype("f4")).astype("bfloat16")
+    out = paddle.logcumsumexp(x, axis=0, dtype="float32")
+    assert "float32" in str(out.dtype)
+
+
+def test_lu_unpack_flags():
+    a = np.random.RandomState(11).randn(4, 4).astype("f4")
+    lu, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv, unpack_ludata=False)
+    assert L is None and U is None and P is not None
+    P2, L2, U2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
+    assert P2 is None and L2 is not None
