@@ -121,15 +121,28 @@ class BertModel(Layer):
                 attention_mask=None):
         import jax.numpy as jnp
 
-        if attention_mask is not None:
-            attention_mask = ensure_tensor(attention_mask)
-            # (B, S) key padding mask → additive (B, 1, 1, S) logits bias
+        input_ids = ensure_tensor(input_ids)
+        if attention_mask is None:
+            # reference behavior: pads derived from pad_token_id
+            pad = self.config.pad_token_id
             attention_mask = apply(
-                lambda m: jnp.where(
-                    m[:, None, None, :].astype(bool), 0.0, -1e9
-                ).astype(jnp.float32),
-                attention_mask, op_name="bert_attn_mask",
+                lambda ids: (ids != pad), input_ids,
+                op_name="bert_pad_mask",
             )
+        attention_mask = ensure_tensor(attention_mask)
+
+        def convert(m):
+            if m.ndim == 4:  # pre-built additive mask: pass through
+                return m.astype(jnp.float32)
+            base = m[:, None, None, :]
+            if jnp.issubdtype(m.dtype, jnp.floating):
+                return base.astype(jnp.float32)  # already additive
+            # bool/int keep-mask → additive bias
+            return jnp.where(base.astype(bool), 0.0, -1e9).astype(
+                jnp.float32)
+
+        attention_mask = apply(
+            convert, attention_mask, op_name="bert_attn_mask")
         hidden = self.embeddings(input_ids, token_type_ids, position_ids)
         hidden = self.encoder(hidden, attention_mask)
         return hidden, self.pooler(hidden)
@@ -141,6 +154,9 @@ class BertLMPredictionHead(Layer):
         self.transform = Linear(config.hidden_size, config.hidden_size)
         self.layer_norm = LayerNorm(config.hidden_size,
                                     config.layer_norm_eps)
+        if embedding_weights is None:  # untied: own decoder table
+            embedding_weights = self.create_parameter(
+                (config.vocab_size, config.hidden_size))
         self._tied = embedding_weights  # (V, E) word embedding table
         self.decoder_bias = self.create_parameter(
             (config.vocab_size,), is_bias=True)

@@ -3,3 +3,6 @@ from .resnet import *  # noqa: F401,F403
 from .lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
+from .sd_unet import (  # noqa: F401
+    SDUNetConfig, UNet2DConditionModel, DDIMScheduler, ddim_sample,
+)

@@ -1,0 +1,360 @@
+"""Stable-Diffusion-class conditional UNet + DDIM sampler (reference:
+the reference's fused SD-UNet inference config — BASELINE.md config #5 —
+and the ppdiffusers UNet2DConditionModel architecture; unverified,
+SURVEY.md §0).
+
+TPU-first inference shape: the whole denoising loop compiles to ONE XLA
+program (``lax.fori_loop`` over timesteps inside ``jit``) — the analog of
+the reference's fused-operator inference pass. Convs hit the MXU via
+``lax.conv_general_dilated`` (NCHW), attention reuses the framework's
+flash/SDPA path, and everything runs in bf16 under AMP if requested.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.common import Linear, LayerList
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import GroupNorm, LayerNorm
+from ...nn import functional as F
+from ...tensor._helpers import Tensor, apply, ensure_tensor
+
+__all__ = ["SDUNetConfig", "UNet2DConditionModel", "DDIMScheduler",
+           "ddim_sample"]
+
+
+class SDUNetConfig:
+    def __init__(self, in_channels=4, out_channels=4,
+                 block_out_channels=(32, 64), layers_per_block=1,
+                 cross_attention_dim=64, attention_head_dim=8,
+                 norm_num_groups=8, sample_size=16):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.block_out_channels = tuple(block_out_channels)
+        self.layers_per_block = layers_per_block
+        self.cross_attention_dim = cross_attention_dim
+        self.attention_head_dim = attention_head_dim
+        self.norm_num_groups = norm_num_groups
+        self.sample_size = sample_size
+
+    @staticmethod
+    def tiny(**overrides):
+        cfg = dict(block_out_channels=(16, 32), cross_attention_dim=32,
+                   attention_head_dim=8, norm_num_groups=4, sample_size=8)
+        cfg.update(overrides)
+        return SDUNetConfig(**cfg)
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep embedding (Tensor in, Tensor out)."""
+    import jax.numpy as jnp
+
+    t = ensure_tensor(t)
+
+    def fn(tv):
+        half = dim // 2
+        freqs = jnp.exp(
+            -math.log(max_period) * jnp.arange(half) / half
+        )
+        ang = tv.astype(jnp.float32)[:, None] * freqs[None, :]
+        return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+    return apply(fn, t, op_name="timestep_embedding")
+
+
+class ResnetBlock2D(Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups):
+        super().__init__()
+        self.norm1 = GroupNorm(groups, in_ch)
+        self.conv1 = Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = Linear(temb_ch, out_ch)
+        self.norm2 = GroupNorm(groups, out_ch)
+        self.conv2 = Conv2D(out_ch, out_ch, 3, padding=1)
+        self.shortcut = (Conv2D(in_ch, out_ch, 1)
+                         if in_ch != out_ch else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = self.shortcut(x) if self.shortcut is not None else x
+        return skip + h
+
+
+class CrossAttnBlock(Layer):
+    """Self-attn + cross-attn + MLP over flattened spatial tokens —
+    the Transformer2DModel analog, routed through the framework's SDPA
+    (→ Pallas flash on TPU for the self-attn branch)."""
+
+    def __init__(self, channels, ctx_dim, head_dim):
+        super().__init__()
+        self.num_heads = max(1, channels // head_dim)
+        self.head_dim = channels // self.num_heads
+        self.norm1 = LayerNorm(channels)
+        self.to_q1 = Linear(channels, channels, bias_attr=False)
+        self.to_k1 = Linear(channels, channels, bias_attr=False)
+        self.to_v1 = Linear(channels, channels, bias_attr=False)
+        self.proj1 = Linear(channels, channels)
+        self.norm2 = LayerNorm(channels)
+        self.to_q2 = Linear(channels, channels, bias_attr=False)
+        self.to_k2 = Linear(ctx_dim, channels, bias_attr=False)
+        self.to_v2 = Linear(ctx_dim, channels, bias_attr=False)
+        self.proj2 = Linear(channels, channels)
+        self.norm3 = LayerNorm(channels)
+        self.ff1 = Linear(channels, channels * 4)
+        self.ff2 = Linear(channels * 4, channels)
+
+    def _attend(self, q, k, v, b, sq, sk):
+        q = q.reshape([b, sq, self.num_heads, self.head_dim])
+        k = k.reshape([b, sk, self.num_heads, self.head_dim])
+        v = v.reshape([b, sk, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(q, k, v)
+        return out.reshape([b, sq, self.num_heads * self.head_dim])
+
+    def forward(self, x, context):
+        # x: (B, C, H, W) → tokens (B, HW, C)
+        b, c, h, w = x.shape
+        tokens = x.reshape([b, c, h * w]).transpose([0, 2, 1])
+        t = self.norm1(tokens)
+        tokens = tokens + self.proj1(self._attend(
+            self.to_q1(t), self.to_k1(t), self.to_v1(t), b, h * w, h * w))
+        t = self.norm2(tokens)
+        sk = context.shape[1]
+        tokens = tokens + self.proj2(self._attend(
+            self.to_q2(t), self.to_k2(context), self.to_v2(context),
+            b, h * w, sk))
+        t = self.norm3(tokens)
+        tokens = tokens + self.ff2(F.gelu(self.ff1(t)))
+        return tokens.transpose([0, 2, 1]).reshape([b, c, h, w])
+
+
+class Downsample2D(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2D(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        import jax
+
+        x = apply(
+            lambda v: jax.image.resize(
+                v, (v.shape[0], v.shape[1], v.shape[2] * 2, v.shape[3] * 2),
+                method="nearest",
+            ), ensure_tensor(x), op_name="upsample_nearest",
+        )
+        return self.conv(x)
+
+
+class UNet2DConditionModel(Layer):
+    """Conditional UNet: down blocks (res + cross-attn + downsample),
+    mid block, up blocks with skip connections."""
+
+    def __init__(self, config: SDUNetConfig = None, **kw):
+        super().__init__()
+        cfg = config or SDUNetConfig(**kw)
+        self.config = cfg
+        chans = cfg.block_out_channels
+        temb_ch = chans[0] * 4
+        g = cfg.norm_num_groups
+
+        self.time_embed_dim = chans[0]
+        self.time_mlp1 = Linear(chans[0], temb_ch)
+        self.time_mlp2 = Linear(temb_ch, temb_ch)
+        self.conv_in = Conv2D(cfg.in_channels, chans[0], 3, padding=1)
+
+        self.down_res = LayerList()
+        self.down_attn = LayerList()
+        self.downsamplers = LayerList()
+        in_ch = chans[0]
+        for level, out_ch in enumerate(chans):
+            res_blocks, attn_blocks = LayerList(), LayerList()
+            for _ in range(cfg.layers_per_block):
+                res_blocks.append(ResnetBlock2D(in_ch, out_ch, temb_ch, g))
+                attn_blocks.append(CrossAttnBlock(
+                    out_ch, cfg.cross_attention_dim, cfg.attention_head_dim))
+                in_ch = out_ch
+            self.down_res.append(res_blocks)
+            self.down_attn.append(attn_blocks)
+            self.downsamplers.append(
+                Downsample2D(out_ch) if level < len(chans) - 1 else Layer()
+            )
+
+        self.mid_res1 = ResnetBlock2D(chans[-1], chans[-1], temb_ch, g)
+        self.mid_attn = CrossAttnBlock(
+            chans[-1], cfg.cross_attention_dim, cfg.attention_head_dim)
+        self.mid_res2 = ResnetBlock2D(chans[-1], chans[-1], temb_ch, g)
+
+        self.up_res = LayerList()
+        self.up_attn = LayerList()
+        self.upsamplers = LayerList()
+        rev = list(reversed(chans))
+        in_ch = chans[-1]
+        for level, out_ch in enumerate(rev):
+            res_blocks, attn_blocks = LayerList(), LayerList()
+            for i in range(cfg.layers_per_block + 1):
+                skip_ch = rev[min(level + (1 if i == cfg.layers_per_block
+                                           else 0), len(rev) - 1)]
+                res_blocks.append(
+                    ResnetBlock2D(in_ch + skip_ch, out_ch, temb_ch, g))
+                attn_blocks.append(CrossAttnBlock(
+                    out_ch, cfg.cross_attention_dim, cfg.attention_head_dim))
+                in_ch = out_ch
+            self.up_res.append(res_blocks)
+            self.up_attn.append(attn_blocks)
+            self.upsamplers.append(
+                Upsample2D(out_ch) if level < len(rev) - 1 else Layer()
+            )
+
+        self.norm_out = GroupNorm(g, chans[0])
+        self.conv_out = Conv2D(chans[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timestep, encoder_hidden_states):
+        temb = timestep_embedding(timestep, self.time_embed_dim)
+        temb = self.time_mlp2(F.silu(self.time_mlp1(temb)))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        n_down = len(self.down_res)
+        for level in range(n_down):
+            for rb, ab in zip(self.down_res[level], self.down_attn[level]):
+                h = rb(h, temb)
+                h = ab(h, encoder_hidden_states)
+                skips.append(h)
+            if level < n_down - 1:
+                h = self.downsamplers[level](h)
+                skips.append(h)
+
+        h = self.mid_res1(h, temb)
+        h = self.mid_attn(h, encoder_hidden_states)
+        h = self.mid_res2(h, temb)
+
+        from ...tensor.manipulation import concat
+
+        n_up = len(self.up_res)
+        for level in range(n_up):
+            for rb, ab in zip(self.up_res[level], self.up_attn[level]):
+                skip = skips.pop()
+                h = rb(concat([h, skip], axis=1), temb)
+                h = ab(h, encoder_hidden_states)
+            if level < n_up - 1:
+                h = self.upsamplers[level](h)
+
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class DDIMScheduler:
+    """Deterministic DDIM sampler (eta=0) over a linear beta schedule."""
+
+    def __init__(self, num_train_timesteps=1000, beta_start=0.00085,
+                 beta_end=0.012):
+        import jax.numpy as jnp
+
+        betas = jnp.linspace(
+            beta_start ** 0.5, beta_end ** 0.5, num_train_timesteps
+        ) ** 2
+        self.alphas_cumprod = jnp.cumprod(1.0 - betas)
+        self.num_train_timesteps = num_train_timesteps
+
+    def timesteps(self, num_inference_steps):
+        if num_inference_steps > self.num_train_timesteps:
+            raise ValueError(
+                f"num_inference_steps ({num_inference_steps}) must be <= "
+                f"num_train_timesteps ({self.num_train_timesteps})"
+            )
+        step = self.num_train_timesteps // num_inference_steps
+        return np.arange(
+            self.num_train_timesteps - 1, -1, -step, dtype=np.int32
+        )[:num_inference_steps]
+
+    def step_fn(self, num_inference_steps):
+        """Returns (timesteps array, pure update fn) for use inside a
+        jitted denoising loop."""
+        import jax.numpy as jnp
+
+        ts = self.timesteps(num_inference_steps)
+        acp = self.alphas_cumprod
+
+        def update(latents, t, t_prev, eps):
+            a_t = acp[t]
+            a_prev = jnp.where(t_prev >= 0, acp[jnp.maximum(t_prev, 0)], 1.0)
+            x0 = (latents - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+            return jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps
+
+        return ts, update
+
+
+_DEFAULT_SCHEDULER = None
+
+
+def ddim_sample(unet, latents, encoder_hidden_states, scheduler=None,
+                num_inference_steps=10):
+    """Full denoising loop compiled into ONE XLA program (fori_loop over
+    timesteps inside jit) — the fused-inference analog of config #5."""
+    import jax
+    import jax.numpy as jnp
+    from ...jit import functional_call
+    from ...core import autograd
+
+    global _DEFAULT_SCHEDULER
+    if scheduler is None:
+        if _DEFAULT_SCHEDULER is None:
+            _DEFAULT_SCHEDULER = DDIMScheduler()
+        scheduler = _DEFAULT_SCHEDULER  # stable identity → cache hits
+    ts, update = scheduler.step_fn(num_inference_steps)
+    latents = ensure_tensor(latents)
+    ctx = ensure_tensor(encoder_hidden_states)
+    params = [p._value for _, p in unet.named_parameters()]
+    buffers = [b._value for _, b in unet.named_buffers()]
+
+    # one compiled program per (scheduler-id, steps) — repeated sampling
+    # reuses the cached executable (shape changes retrace inside jit)
+    try:
+        cache = unet._ddim_loops
+    except AttributeError:
+        cache = {}
+        object.__setattr__(unet, "_ddim_loops", cache)
+    key = (id(scheduler.alphas_cumprod), num_inference_steps)
+    if key not in cache:
+        ts_arr = jnp.asarray(ts)
+        n = len(ts)
+
+        def eps_fn(p_vals, b_vals, lat, t_scalar, ctx_v):
+            t_batch = jnp.broadcast_to(t_scalar, (lat.shape[0],))
+            with autograd.no_grad():
+                out, _ = functional_call(
+                    unet, unet.forward,
+                    [Tensor(lat, stop_gradient=True),
+                     Tensor(t_batch, stop_gradient=True),
+                     Tensor(ctx_v, stop_gradient=True)],
+                    {}, p_vals, b_vals,
+                )
+            return out._value
+
+        @jax.jit
+        def loop(p_vals, b_vals, lat0, ctx_v):
+            def body(i, lat):
+                t = ts_arr[i]
+                t_prev = jnp.where(
+                    i + 1 < n, ts_arr[jnp.minimum(i + 1, n - 1)], -1
+                )
+                eps = eps_fn(p_vals, b_vals, lat, t, ctx_v)
+                return update(lat, t, t_prev, eps)
+
+            return jax.lax.fori_loop(0, n, body, lat0)
+
+        cache[key] = loop
+
+    out = cache[key](params, buffers, latents._value, ctx._value)
+    return Tensor(out, stop_gradient=True)

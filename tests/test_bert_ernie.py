@@ -117,3 +117,57 @@ def test_ernie_pretrain_via_auto_parallel_engine():
     eng = Engine(model, loss_fn, opt, strategy=strategy)
     hist = eng.fit(MLMData(), batch_size=16, epochs=3, verbose=0)
     assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_bert_pad_token_mask_derived():
+    """attention_mask=None derives padding from pad_token_id (reference
+    behavior)."""
+    paddle.seed(0)
+    cfg = BertConfig.tiny(pad_token_id=0)
+    m = BertModel(cfg)
+    m.eval()
+    ids = np.asarray(_ids()._value).copy()
+    ids[:, 8:] = 0  # pads
+    ids[ids == 0] = np.where(
+        np.arange(ids.shape[1])[None, :].repeat(2, 0)[ids == 0] < 8, 3, 0)
+    h1, _ = m(paddle.to_tensor(ids))
+    ids2 = ids.copy()
+    # changing nothing (pads already masked): re-run equals
+    h2, _ = m(paddle.to_tensor(ids2))
+    np.testing.assert_allclose(
+        np.asarray(h1._value), np.asarray(h2._value), rtol=1e-6)
+    # explicit mask equivalent to the derived one
+    mask = (ids != 0).astype("i4")
+    h3, _ = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(
+        np.asarray(h1._value), np.asarray(h3._value), rtol=1e-5, atol=1e-6)
+
+
+def test_bert_additive_and_4d_masks():
+    paddle.seed(0)
+    m = BertModel(BertConfig.tiny())
+    m.eval()
+    ids = _ids()
+    keep = np.ones((2, 16), "i4")
+    keep[:, 12:] = 0
+    ref, _ = m(ids, attention_mask=paddle.to_tensor(keep))
+    # float additive 2D mask {0, -1e9}
+    additive = np.where(keep.astype(bool), 0.0, -1e9).astype("f4")
+    h2, _ = m(ids, attention_mask=paddle.to_tensor(additive))
+    np.testing.assert_allclose(
+        np.asarray(ref._value), np.asarray(h2._value), rtol=1e-5, atol=1e-6)
+    # pre-built 4D additive mask
+    h3, _ = m(ids, attention_mask=paddle.to_tensor(
+        additive[:, None, None, :]))
+    np.testing.assert_allclose(
+        np.asarray(ref._value), np.asarray(h3._value), rtol=1e-5, atol=1e-6)
+
+
+def test_untied_lm_head_owns_decoder():
+    from paddle_tpu.nlp.bert import BertLMPredictionHead
+
+    paddle.seed(0)
+    head = BertLMPredictionHead(BertConfig.tiny())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 32).astype("f4"))
+    out = head(x)
+    assert out.shape == [2, 4, 128]
