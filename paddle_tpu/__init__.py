@@ -108,3 +108,4 @@ from . import fft  # noqa: E402
 from . import quantization  # noqa: E402
 from . import sparse  # noqa: E402
 from . import device  # noqa: E402
+from . import visualdl  # noqa: E402

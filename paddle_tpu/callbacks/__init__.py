@@ -3,3 +3,4 @@ from ..hapi.callbacks import (  # noqa: F401
     Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
     Terminate,
 )
+from ..hapi.callbacks import VisualDL  # noqa: F401

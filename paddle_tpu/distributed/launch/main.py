@@ -1,19 +1,32 @@
 """python -m paddle_tpu.distributed.launch (reference:
-python/paddle/distributed/launch/main.py — unverified, SURVEY.md §0).
+python/paddle/distributed/launch/main.py + controllers/ — unverified,
+SURVEY.md §0).
 
-The reference spawns one process per GPU; TPU-native launch runs ONE
-controller process per host — intra-host parallelism is the mesh. For
-multi-host ("nnodes"), it exports the coordinator env consumed by
-``init_parallel_env`` (jax.distributed.initialize) and execs the script.
-The PADDLE_* env contract is preserved so reference training scripts run
-unmodified.
+The reference spawns one process per GPU under a controller that
+aggregates logs and tears the job down on first failure. TPU-native
+launch keeps that controller shape:
+
+- default: ONE process per host (intra-host parallelism is the mesh; a
+  single process drives every local chip).
+- ``--nproc_per_node N > 1``: N worker processes (CPU-mesh testing /
+  multi-host simulation), each with the PADDLE_* env contract
+  (PADDLE_TRAINER_ID / PADDLE_LOCAL_RANK / PADDLE_TRAINERS_NUM), per-rank
+  log files, controller-side log tailing with ``[rank N]`` prefixes, and
+  fail-fast: first non-zero exit terminates the remaining workers
+  (the reference controller's watch loop).
+- multi-host: ``--master ip:port --nnodes M --rank r`` exports the
+  coordinator env consumed by ``init_parallel_env``
+  (jax.distributed.initialize).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import signal
 import subprocess
 import sys
+import threading
+import time
 
 __all__ = ["main"]
 
@@ -25,9 +38,10 @@ def _parse_args(argv=None):
     parser.add_argument("--nnodes", type=int, default=1)
     parser.add_argument("--rank", type=int,
                         default=int(os.environ.get("PADDLE_TRAINER_ID", 0)),
-                        help="node rank (process id)")
+                        help="node rank")
     parser.add_argument("--nproc_per_node", type=int, default=1,
-                        help="accepted for compat; TPU runs 1 proc/host")
+                        help="worker processes on this host (TPU default 1: "
+                             "one process drives all local chips)")
     parser.add_argument("--devices", "--gpus", dest="devices", default=None,
                         help="accepted for compat (mesh covers all chips)")
     parser.add_argument("--job_id", default="default")
@@ -38,28 +52,121 @@ def _parse_args(argv=None):
     return parser.parse_args(argv)
 
 
-def main(argv=None):
-    args = _parse_args(argv)
+def _worker_env(args, local_rank):
     env = dict(os.environ)
-    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
-    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    world = args.nnodes * args.nproc_per_node
+    global_rank = args.rank * args.nproc_per_node + local_rank
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_TRAINER_ID"] = str(global_rank)
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    env["PADDLE_NNODES"] = str(args.nnodes)
+    env["PADDLE_JOB_ID"] = args.job_id
     if args.master:
         env["PADDLE_MASTER"] = args.master
-    env.setdefault("PADDLE_LOCAL_RANK", "0")
-    env["PADDLE_JOB_ID"] = args.job_id
+    return env
 
+
+def _tail(stream, rank, logf):
+    """Controller-side log aggregation: every worker line goes to the
+    controller stdout with a rank prefix AND to its per-rank file."""
+    for raw in iter(stream.readline, b""):
+        line = raw.decode(errors="replace")
+        sys.stdout.write(f"[rank {rank}] {line}")
+        sys.stdout.flush()
+        if logf is not None:
+            logf.write(raw)
+            logf.flush()
+    stream.close()
+
+
+def main(argv=None):
+    args = _parse_args(argv)
     cmd = [sys.executable, args.training_script] + args.training_script_args
+
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-        log_path = os.path.join(
-            args.log_dir, f"worker.{args.rank}.log"
-        )
-        with open(log_path, "ab") as logf:
-            proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
-            ret = proc.wait()
-    else:
-        proc = subprocess.Popen(cmd, env=env)
-        ret = proc.wait()
+
+    procs = []
+    tails = []
+    logfiles = []
+    for local_rank in range(args.nproc_per_node):
+        env = _worker_env(args, local_rank)
+        logf = None
+        if args.log_dir:
+            global_rank = env["PADDLE_TRAINER_ID"]
+            logf = open(
+                os.path.join(args.log_dir, f"worker.{global_rank}.log"), "ab"
+            )
+            logfiles.append(logf)
+        if args.nproc_per_node == 1 and not args.log_dir:
+            proc = subprocess.Popen(cmd, env=env)  # passthrough stdio
+        else:
+            proc = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            t = threading.Thread(
+                target=_tail, args=(proc.stdout, local_rank, logf),
+                daemon=True,
+            )
+            t.start()
+            tails.append(t)
+        procs.append(proc)
+
+    # controller watch loop: fail-fast on the first non-zero exit, with
+    # SIGTERM → (grace period) → SIGKILL escalation so a worker trapping
+    # SIGTERM (e.g. PreemptionGuard) can't hang the job
+    GRACE_S = 10.0
+    ret = 0
+    term_at = None
+    alive = {p.pid: p for p in procs}
+    try:
+        while alive:
+            for pid, p in list(alive.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del alive[pid]
+                if rc != 0 and ret == 0:
+                    # first failure wins; the SIGTERMs we send below make
+                    # the other workers exit non-zero too — don't let
+                    # those overwrite the real failure code
+                    print(
+                        f"[launch] worker pid={pid} exited rc={rc}; "
+                        "terminating remaining workers",
+                        file=sys.stderr,
+                    )
+                    ret = rc
+                    term_at = time.monotonic()
+                    for q in alive.values():
+                        q.terminate()
+            if term_at is not None and alive \
+                    and time.monotonic() - term_at > GRACE_S:
+                print(
+                    f"[launch] {len(alive)} worker(s) survived SIGTERM "
+                    f"{GRACE_S:.0f}s; killing", file=sys.stderr,
+                )
+                for q in alive.values():
+                    q.kill()
+                term_at = time.monotonic()  # re-arm (kill is decisive)
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        ret = 130
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        deadline = time.monotonic() + GRACE_S
+        for p in procs:
+            remain = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    for t in tails:
+        t.join(timeout=5)
+    for f in logfiles:
+        f.close()
     sys.exit(ret)
 
 

@@ -10,7 +10,8 @@ import numpy as np
 
 __all__ = [
     "Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-    "LRScheduler", "Terminate", "config_callbacks", "CallbackList",
+    "LRScheduler", "Terminate", "VisualDL", "config_callbacks",
+    "CallbackList",
 ]
 
 
@@ -232,3 +233,42 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "verbose": verbose, "metrics": metrics or [],
     })
     return lst
+
+
+class VisualDL(Callback):
+    """Streams train/eval scalars to a VisualDL LogWriter
+    (reference: paddle.callbacks.VisualDL)."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._train_step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..visualdl import LogWriter
+
+            self._writer = LogWriter(logdir=self.log_dir)
+        return self._writer
+
+    def _log_all(self, prefix, step, logs):
+        for k, v in (logs or {}).items():
+            try:
+                self._w().add_scalar(f"{prefix}/{k}", float(v), step)
+            except (TypeError, ValueError):
+                pass  # non-scalar entries (e.g. batch size lists)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log_all("train", self._train_step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log_all("train_epoch", epoch, logs)
+
+    def on_eval_end(self, logs=None):
+        self._log_all("eval", self._train_step, logs)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()

@@ -1,0 +1,127 @@
+"""Launch controller (multi-proc, log aggregation, fail-fast) and the
+VisualDL writer/callback (SURVEY.md §5 observability + launcher rows)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.visualdl import LogWriter, LogReader
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(tmp_path, script_body, extra_args):
+    script = tmp_path / "worker.py"
+    script.write_text(script_body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch", *extra_args,
+         str(script)],
+        env=env, capture_output=True, timeout=120,
+    )
+
+
+def test_launch_multiproc_env_and_log_aggregation(tmp_path):
+    body = (
+        "import os\n"
+        "print('hello rank', os.environ['PADDLE_TRAINER_ID'],\n"
+        "      'of', os.environ['PADDLE_TRAINERS_NUM'],\n"
+        "      'local', os.environ['PADDLE_LOCAL_RANK'])\n"
+    )
+    logdir = tmp_path / "logs"
+    r = _launch(tmp_path, body,
+                ["--nproc_per_node", "2", "--log_dir", str(logdir)])
+    assert r.returncode == 0, r.stderr
+    out = r.stdout.decode()
+    assert "[rank 0] hello rank 0 of 2 local 0" in out
+    assert "[rank 1] hello rank 1 of 2 local 1" in out
+    # per-rank files exist and carry the same lines
+    assert "hello rank 0" in (logdir / "worker.0.log").read_text()
+    assert "hello rank 1" in (logdir / "worker.1.log").read_text()
+
+
+def test_launch_fail_fast_on_worker_error(tmp_path):
+    body = (
+        "import os, sys, time\n"
+        "if os.environ['PADDLE_TRAINER_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(30)\n"  # must be killed, not waited for
+    )
+    r = _launch(tmp_path, body, ["--nproc_per_node", "2"])
+    assert r.returncode == 3
+    assert b"terminating remaining workers" in r.stderr
+
+
+def test_logwriter_scalars_roundtrip(tmp_path):
+    logdir = str(tmp_path / "vdl")
+    with LogWriter(logdir=logdir) as w:
+        for i in range(5):
+            w.add_scalar("loss", 1.0 / (i + 1), i)
+        w.add_histogram("grads", np.random.randn(100), 0)
+        w.add_text("note", "hello", 0)
+        w.add_hparams({"lr": 0.1}, ["loss"])
+    reader = LogReader(logdir)
+    series = reader.scalars("loss")
+    assert [s for s, _ in series] == list(range(5))
+    assert series[0][1] == 1.0
+    assert "loss" in reader.tags()
+
+
+def test_visualdl_callback_with_hapi_fit(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.io import Dataset
+
+    class Data(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(32, 8).astype("f4")
+            self.y = (np.abs(self.x.sum(1)) % 2).astype("i8")
+
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(1e-2, parameters=net.parameters()),
+        nn.CrossEntropyLoss(),
+    )
+    logdir = str(tmp_path / "vdl_cb")
+    cb = paddle.callbacks.VisualDL(log_dir=logdir)
+    model.fit(Data(), batch_size=8, epochs=2, verbose=0, callbacks=[cb])
+    reader = LogReader(logdir)
+    assert any(t.startswith("train") for t in reader.tags())
+    assert len(reader.scalars("train/loss")) > 0
+
+
+def test_launch_kills_sigterm_trapping_worker(tmp_path):
+    """Fail-fast must escalate to SIGKILL when a worker traps SIGTERM."""
+    body = (
+        "import os, signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, lambda *a: None)  # trap + ignore\n"
+        "if os.environ['PADDLE_TRAINER_ID'] == '0':\n"
+        "    sys.exit(7)\n"
+        "time.sleep(120)\n"
+    )
+    import time as _time
+
+    t0 = _time.monotonic()
+    r = _launch(tmp_path, body, ["--nproc_per_node", "2"])
+    assert r.returncode == 7
+    assert _time.monotonic() - t0 < 60  # escalation, not a 120s hang
+    assert b"killing" in r.stderr
+
+
+def test_histogram_empty_input_ok(tmp_path):
+    with LogWriter(logdir=str(tmp_path / "v")) as w:
+        w.add_histogram("empty", [], 0)  # must not raise
