@@ -318,6 +318,13 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            raise TypeError(
+                "bool() on a traced Tensor inside jit/to_static: Python "
+                "control flow would be baked at trace time. Use "
+                "paddle.static.nn.cond / while_loop / switch_case (XLA "
+                "structured control flow) or paddle.where instead."
+            )
         return bool(self.numpy())
 
     def __int__(self):

@@ -305,3 +305,4 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..core.autograd import grad
 
     return grad(targets, inputs, target_gradients, allow_unused=True)
+from . import nn  # noqa: E402,F401

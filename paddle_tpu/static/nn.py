@@ -1,0 +1,159 @@
+"""paddle.static.nn control-flow ops (reference:
+python/paddle/static/nn/control_flow.py — unverified, SURVEY.md §0).
+
+The reference builds While/Conditional blocks into the ProgramDesc; the
+TPU-native forms ARE the XLA structured-control-flow primitives
+(``lax.cond`` / ``lax.while_loop`` / ``lax.switch``), so the same user
+code works eagerly AND inside ``paddle.jit.to_static`` traces — this is
+the framework's answer to data-dependent Python ``if``/``while`` that a
+trace would otherwise bake (SURVEY §2.4 dy2static row).
+
+Execution strategy: with a CONCRETE predicate (eager mode) only the
+taken branch runs, directly on the autograd tape — lazy AND fully
+differentiable, like the reference's dygraph cond. With a TRACED
+predicate (inside jit) the op lowers to the lax primitive; grads then
+come from ``jax.grad`` over the enclosing jitted function (cond/switch
+reverse-differentiable, while_loop forward-only — XLA can't reverse an
+unbounded loop).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _as_bool_scalar(pred):
+    pred = ensure_tensor(pred)
+    return pred
+
+
+def _tree_vals(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """paddle.static.nn.cond: lazily evaluate one branch.
+
+    Branch functions take no arguments (capture by closure, like the
+    reference) and must return matching structures."""
+    pred = _as_bool_scalar(pred)
+    if true_fn is None:
+        true_fn = lambda: None  # noqa: E731 — reference allows omitting
+    if false_fn is None:
+        false_fn = lambda: None  # noqa: E731
+    if not isinstance(pred._value, jax.core.Tracer):
+        # concrete predicate: run only the taken branch ON the tape
+        return true_fn() if bool(pred._value) else false_fn()
+
+    def fn(p):
+        def _true(_):
+            return _tree_vals(true_fn())
+
+        def _false(_):
+            return _tree_vals(false_fn())
+
+        return jax.lax.cond(
+            jnp.asarray(p).astype(bool).reshape(()), _true, _false,
+            operand=None,
+        )
+
+    return apply(fn, pred, op_name="cond")
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop over lax.while_loop.
+
+    ``loop_vars`` is a list; cond/body receive the unpacked vars as
+    Tensors and body returns the same structure."""
+    loop_vars = [ensure_tensor(v) for v in loop_vars]
+    traced = any(
+        isinstance(v._value, jax.core.Tracer) for v in loop_vars
+    )
+    if not traced:
+        # eager: drive the loop in Python on the tape (grads unroll,
+        # matching the reference's dygraph while semantics)
+        vars_ = list(loop_vars)
+        while bool(ensure_tensor(cond_fn(*vars_))._value):
+            out = body_fn(*vars_)
+            out = out if isinstance(out, (list, tuple)) else [out]
+            vars_ = [ensure_tensor(o) for o in out]
+        return list(vars_)
+
+    def fn(*vals):
+        def _cond(carry):
+            out = cond_fn(*[Tensor(v, stop_gradient=True) for v in carry])
+            out = out._value if isinstance(out, Tensor) else out
+            return jnp.asarray(out).astype(bool).reshape(())
+
+        def _body(carry):
+            out = body_fn(*[Tensor(v, stop_gradient=True) for v in carry])
+            out = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(
+                (o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                 ).astype(c.dtype).reshape(c.shape)
+                for o, c in zip(out, carry)
+            )
+
+        return jax.lax.while_loop(_cond, _body, tuple(vals))
+
+    out = apply(fn, *loop_vars, op_name="while_loop")
+    return list(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is True wins (reference paddle.static.nn.case).
+
+    Lowers to nested lax.cond so every pred stays traced."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+
+    def build(pairs):
+        if not pairs:
+            return default()
+        pred, f = pairs[0]
+        return cond(pred, f, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer dispatch over branches (lax.switch)."""
+    branch_index = ensure_tensor(branch_index)
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+    if not isinstance(branch_index._value, jax.core.Tracer):
+        i = int(branch_index._value)
+        return (fns[keys.index(i)] if i in keys else default)()
+
+    def fn(idx):
+        idx = jnp.asarray(idx).reshape(())
+        # map the (possibly sparse) keys onto dense switch slots; the
+        # last slot is the default branch
+        branch_slot = jnp.full((), len(fns), jnp.int32)
+        for slot, k in enumerate(keys):
+            branch_slot = jnp.where(idx == k, slot, branch_slot)
+        wrapped = [
+            (lambda _, f=f: _tree_vals(f())) for f in fns
+        ] + [lambda _: _tree_vals(default())]
+        return jax.lax.switch(branch_slot, wrapped, None)
+
+    return apply(fn, branch_index, op_name="switch_case")

@@ -1,0 +1,245 @@
+"""Secondary benchmark suite: the BASELINE.md config table beyond the
+headline (bench.py stays the driver's single-JSON-line contract).
+
+Runs each config at a single-chip-feasible scale and prints one JSON
+line per config; results are recorded in BENCH_NOTES.md.
+
+    PYTHONPATH=. python scripts/bench_suite.py [config ...]
+
+Configs: resnet50_eager | resnet50_jit | gpt2_jit | ernie_engine |
+sd_unet  (the Llama MFU headline lives in bench.py)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _time_it(fn, warmup=2, iters=5):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def resnet50_eager():
+    """Config #1: ResNet-50 eager train step, images/sec."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    ce = paddle.nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    batch = 32
+    x = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype("f4"))
+    y = paddle.to_tensor(rng.randint(0, 1000, batch).astype("i8"))
+
+    def step():
+        loss = ce(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step()  # compile ops
+    dt = _time_it(step, warmup=1, iters=3)
+    return {"metric": "resnet50_eager_images_per_sec",
+            "value": round(batch / dt, 1), "unit": "img/s"}
+
+
+def gpt2_jit():
+    """Config #2: GPT-2 345M-class static-graph (jitted) train step."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+    from paddle_tpu.jit.train import JittedTrainStep
+    from paddle_tpu.profiler.mfu import (
+        MFUMeter, transformer_train_flops,
+    )
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_hidden_layers=24,
+            num_attention_heads=16, intermediate_size=4096,
+            max_position_embeddings=1024,
+        )
+        batch, seq = 8, 1024
+    else:
+        cfg = GPTConfig.tiny()
+        batch, seq = 2, 32
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.astype("bfloat16")
+    ce = paddle.nn.CrossEntropyLoss()
+
+    def crit(out, labels):
+        return ce(out.astype("float32").reshape([-1, cfg.vocab_size]),
+                  labels.reshape([-1]))
+
+    opt = paddle.optimizer.AdamW(
+        1e-4, parameters=model.parameters(), multi_precision=True,
+        moment_dtype="bfloat16",
+    )
+    step = JittedTrainStep(model, crit, opt)
+    n = sum(int(np.prod(p._value.shape))
+            for _, p in model.named_parameters())
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)))
+    flops = transformer_train_flops(
+        n, batch * seq, num_layers=cfg.num_hidden_layers, seq_len=seq,
+        hidden=cfg.hidden_size, causal=True)
+    meter = MFUMeter(flops, batch * seq)
+    res = meter.measure(lambda: step(ids, ids), warmup=2,
+                        iters=5 if on_tpu else 2)
+    out = {"metric": "gpt2_345m_jit_tokens_per_sec",
+           "value": round(res["tokens_per_sec"], 1), "unit": "tok/s",
+           "params_m": round(n / 1e6)}
+    if res.get("mfu"):
+        out["mfu_pct"] = round(res["mfu"] * 100, 2)
+    return out
+
+
+def ernie_engine():
+    """Config #4: ERNIE pretrain step via the auto-parallel Engine."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import (
+        ErnieConfig, ErnieForPretraining, BertPretrainingCriterion,
+    )
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.io import Dataset
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = (ErnieConfig(num_hidden_layers=6, hidden_size=512,
+                       num_attention_heads=8, intermediate_size=2048,
+                       max_position_embeddings=512,
+                       hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0)
+           if on_tpu else ErnieConfig.tiny())
+    batch, seq = (16, 256) if on_tpu else (4, 16)
+
+    class Data(Dataset):
+        def __init__(self, n=batch * 8):
+            rng = np.random.RandomState(0)
+            self.ids = rng.randint(
+                1, cfg.vocab_size, (n, seq)).astype("i8")
+            self.labels = np.full((n, seq), -100, "i8")
+            self.labels[:, ::7] = self.ids[:, ::7]
+
+        def __len__(self):
+            return len(self.ids)
+
+        def __getitem__(self, i):
+            return self.ids[i], self.labels[i]
+
+    paddle.seed(0)
+    model = ErnieForPretraining(cfg)
+    crit = BertPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    eng = Engine(model, lambda out, lb: crit(out[0], out[1], lb), opt)
+    t0 = time.perf_counter()
+    eng.fit(Data(), batch_size=batch, epochs=1, verbose=0)
+    dt = time.perf_counter() - t0
+    steps = 8
+    return {"metric": "ernie_engine_tokens_per_sec",
+            "value": round(steps * batch * seq / dt, 1), "unit": "tok/s",
+            "note": "incl. first-step compile"}
+
+
+def sd_unet():
+    """Config #5: SD-UNet fused-inference denoising latency."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import (
+        SDUNetConfig, UNet2DConditionModel, ddim_sample,
+    )
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = (SDUNetConfig(block_out_channels=(64, 128),
+                        cross_attention_dim=256, sample_size=32)
+           if on_tpu else SDUNetConfig.tiny())
+    steps = 20 if on_tpu else 3
+    paddle.seed(0)
+    unet = UNet2DConditionModel(cfg)
+    unet.eval()
+    rng = np.random.RandomState(0)
+    lat = paddle.to_tensor(rng.randn(
+        1, cfg.in_channels, cfg.sample_size, cfg.sample_size).astype("f4"))
+    ctx = paddle.to_tensor(
+        rng.randn(1, 16, cfg.cross_attention_dim).astype("f4"))
+
+    def run():
+        out = ddim_sample(unet, lat, ctx, num_inference_steps=steps)
+        np.asarray(out._value)  # block
+
+    run()  # compile
+    dt = _time_it(run, warmup=1, iters=3)
+    return {"metric": "sd_unet_denoise_latency_ms",
+            "value": round(dt * 1000, 1), "unit": f"ms/{steps}-step sample"}
+
+
+def resnet50_jit():
+    """Config #1 under the perf path: same ResNet-50 step, one XLA
+    program (forward+loss+backward+momentum update fused)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.jit.train import JittedTrainStep
+
+    paddle.seed(0)
+    model = resnet50()
+    ce = paddle.nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    step = JittedTrainStep(model, lambda out, y: ce(out, y), opt)
+    rng = np.random.RandomState(0)
+    batch = 64
+    x = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype("f4"))
+    y = paddle.to_tensor(rng.randint(0, 1000, batch).astype("i8"))
+
+    def run():
+        loss = step(x, y)
+        np.asarray(loss._value)
+
+    run()  # compile
+    dt = _time_it(run, warmup=1, iters=5)
+    return {"metric": "resnet50_jit_images_per_sec",
+            "value": round(batch / dt, 1), "unit": "img/s"}
+
+
+CONFIGS = {
+    "resnet50_eager": resnet50_eager,
+    "resnet50_jit": resnet50_jit,
+    "gpt2_jit": gpt2_jit,
+    "ernie_engine": ernie_engine,
+    "sd_unet": sd_unet,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    for name in names:
+        log(f"== {name} ==")
+        t0 = time.perf_counter()
+        try:
+            out = CONFIGS[name]()
+            out["wall_s"] = round(time.perf_counter() - t0, 1)
+            print(json.dumps(out), flush=True)
+        except Exception as e:
+            print(json.dumps(
+                {"metric": name, "error": f"{type(e).__name__}: {e}"[:200]}),
+                flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""paddle.static.nn control flow over XLA structured primitives
+(SURVEY.md §2.4 dy2static row: data-dependent control flow that a trace
+can't bake)."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import cond, while_loop, case, switch_case
+
+
+def test_cond_eager_both_branches():
+    x = paddle.to_tensor(np.array(3.0, "f4"))
+    out = cond(x > 0, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+    out = cond(x < 0, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 2.0
+
+
+def test_cond_inside_jit_traces_lazily():
+    @jax.jit
+    def f(v):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.core import autograd
+
+        with autograd.no_grad():
+            t = Tensor(v, stop_gradient=True)
+            out = cond(t.sum() > 0, lambda: t * 10, lambda: t * -1)
+        return out._value
+
+    np.testing.assert_allclose(np.asarray(f(np.ones(3, "f4"))), [10] * 3)
+    np.testing.assert_allclose(np.asarray(f(-np.ones(3, "f4"))), [1] * 3)
+
+
+def test_cond_gradients_flow():
+    x = paddle.to_tensor(np.array([2.0], "f4"))
+    x.stop_gradient = False
+    out = cond(x.sum() > 0, lambda: (x ** 2).sum(), lambda: x.sum())
+    (g,) = paddle.grad(out, [x])
+    np.testing.assert_allclose(np.asarray(g._value), [4.0], rtol=1e-6)
+
+
+def test_while_loop_counts():
+    i = paddle.to_tensor(np.array(0, "i4"))
+    s = paddle.to_tensor(np.array(0.0, "f4"))
+    i2, s2 = while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: [i + 1, s + 2.0],
+        [i, s],
+    )
+    assert int(i2) == 5 and float(s2) == 10.0
+
+
+def test_case_and_switch():
+    x = paddle.to_tensor(np.array(1.0, "f4"))
+    out = case(
+        [(x > 2, lambda: x * 100), (x > 0, lambda: x * 10)],
+        default=lambda: x,
+    )
+    assert float(out) == 10.0
+
+    idx = paddle.to_tensor(np.array(2, "i4"))
+    out = switch_case(
+        idx,
+        {0: lambda: x + 1, 2: lambda: x + 2, 5: lambda: x + 5},
+    )
+    assert float(out) == 3.0
+    out = switch_case(  # unknown index → default (last branch)
+        paddle.to_tensor(np.array(7, "i4")),
+        {0: lambda: x + 1, 2: lambda: x + 2, 5: lambda: x + 5},
+    )
+    assert float(out) == 6.0
+
+
+def test_traced_bool_raises_helpfully():
+    @jax.jit
+    def f(v):
+        from paddle_tpu.core.tensor import Tensor
+
+        t = Tensor(v, stop_gradient=True)
+        if t > 0:  # Python branch on traced value
+            return v
+        return -v
+
+    with pytest.raises(TypeError, match="static.nn.cond"):
+        f(np.ones((), "f4"))
+
+
+def test_while_loop_eager_grads_unroll():
+    x = paddle.to_tensor(np.array(2.0, "f4"))
+    x.stop_gradient = False
+    i = paddle.to_tensor(np.array(0, "i4"))
+    # y = x * 2^3 after three doublings
+    _, y = while_loop(
+        lambda i, y: i < 3,
+        lambda i, y: [i + 1, y * 2.0],
+        [i, x],
+    )
+    (g,) = paddle.grad(y, [x])
+    assert float(y) == 16.0 and float(g) == 8.0
+
+
+def test_cond_single_branch_returns_none():
+    x = paddle.to_tensor(np.array(-1.0, "f4"))
+    assert cond(x > 0, lambda: x * 2) is None
