@@ -58,16 +58,27 @@ def wrap_single(value, stop_gradient=True):
     return Tensor(value, stop_gradient=stop_gradient)
 
 
-def _check_nan_inf(name, flat_vals):
-    import numpy as np
+def _nan_report(name, bad):
+    if bad:
+        raise FloatingPointError(
+            f"FLAGS_check_nan_inf: op '{name}' produced NaN/Inf"
+        )
 
+
+def _check_nan_inf(name, flat_vals):
     for v in flat_vals:
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
-            if not isinstance(v, jax.core.Tracer):
-                if bool(jnp.any(~jnp.isfinite(v))):
-                    raise FloatingPointError(
-                        f"FLAGS_check_nan_inf: op '{name}' produced NaN/Inf"
-                    )
+            if isinstance(v, jax.core.Tracer):
+                # jitted path: a host callback carries the check into the
+                # compiled program (debug-flag overhead is acceptable —
+                # the reference's check_nan_inf pass also syncs)
+                jax.debug.callback(
+                    _nan_report, name, jnp.any(~jnp.isfinite(v))
+                )
+            elif bool(jnp.any(~jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: op '{name}' produced NaN/Inf"
+                )
 
 
 def apply(fn, *args, op_name: str = "", **kwargs):

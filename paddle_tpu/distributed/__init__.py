@@ -119,18 +119,46 @@ def _ensure_tensor(t):
     return t if isinstance(t, Tensor) else Tensor(t)
 
 
+class Task:
+    """Async-collective handle (reference: the ProcessGroup task returned
+    by sync_op=False calls). XLA dispatch is already asynchronous, so the
+    handle's job is the ``wait`` barrier on the result value."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        vals = [
+            t._value for t in (
+                self._result if isinstance(self._result, (list, tuple))
+                else [self._result]
+            )
+            if isinstance(t, Tensor)
+        ]
+        if vals:
+            jax.block_until_ready(vals)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _maybe_task(result, sync_op):
+    return result if sync_op else Task(result)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """On replicated/global data this is the identity (the value already
     includes every shard's contribution under GSPMD); kept for API parity."""
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def barrier(group=None):
@@ -144,8 +172,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if isinstance(tensor_list, list):
         del tensor_list[:]
         tensor_list.extend(Tensor(t._value) for _ in range(max(n, 1)))
-        return tensor_list
-    return [Tensor(t._value) for _ in range(max(n, 1))]
+        return _maybe_task(tensor_list, sync_op)
+    return _maybe_task([Tensor(t._value) for _ in range(max(n, 1))], sync_op)
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -158,13 +186,13 @@ def all_gather_object(object_list, obj, group=None):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
         tensor.set_value(tensor_list[get_rank(group)])
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     del out_tensor_list[:]
     out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
-    return out_tensor_list
+    return _maybe_task(out_tensor_list, sync_op)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

@@ -62,10 +62,17 @@ def cond(pred, true_fn=None, false_fn=None, name=None,
         def _false(_):
             return _tree_vals(false_fn())
 
-        return jax.lax.cond(
-            jnp.asarray(p).astype(bool).reshape(()), _true, _false,
-            operand=None,
-        )
+        try:
+            return jax.lax.cond(
+                jnp.asarray(p).astype(bool).reshape(()), _true, _false,
+                operand=None,
+            )
+        except TypeError as e:
+            raise TypeError(
+                "cond: under a traced predicate both branches must return "
+                "matching structures (provide an explicit false_fn whose "
+                f"output mirrors true_fn's): {e}"
+            ) from e
 
     return apply(fn, pred, op_name="cond")
 
@@ -98,11 +105,18 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         def _body(carry):
             out = body_fn(*[Tensor(v, stop_gradient=True) for v in carry])
             out = out if isinstance(out, (list, tuple)) else [out]
-            return tuple(
-                (o._value if isinstance(o, Tensor) else jnp.asarray(o)
-                 ).astype(c.dtype).reshape(c.shape)
-                for o, c in zip(out, carry)
-            )
+            vals = []
+            for i, (o, c) in enumerate(zip(out, carry)):
+                v = o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                if v.dtype != c.dtype or v.shape != c.shape:
+                    raise TypeError(
+                        f"while_loop: body output {i} has "
+                        f"{v.dtype}{list(v.shape)} but the loop var is "
+                        f"{c.dtype}{list(c.shape)}; carries must be "
+                        "shape/dtype-stable"
+                    )
+                vals.append(v)
+            return tuple(vals)
 
         return jax.lax.while_loop(_cond, _body, tuple(vals))
 
@@ -146,14 +160,18 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     def fn(idx):
         idx = jnp.asarray(idx).reshape(())
-        # map the (possibly sparse) keys onto dense switch slots; the
-        # last slot is the default branch
-        branch_slot = jnp.full((), len(fns), jnp.int32)
+        # map the (possibly sparse) keys onto dense switch slots; when
+        # the default IS the last branch, reuse its slot instead of
+        # tracing the same function twice into the program
+        wrapped = [(lambda _, f=f: _tree_vals(f())) for f in fns]
+        if default is fns[-1]:
+            default_slot = len(fns) - 1
+        else:
+            wrapped.append(lambda _: _tree_vals(default()))
+            default_slot = len(fns)
+        branch_slot = jnp.full((), default_slot, jnp.int32)
         for slot, k in enumerate(keys):
             branch_slot = jnp.where(idx == k, slot, branch_slot)
-        wrapped = [
-            (lambda _, f=f: _tree_vals(f())) for f in fns
-        ] + [lambda _: _tree_vals(default())]
         return jax.lax.switch(branch_slot, wrapped, None)
 
     return apply(fn, branch_index, op_name="switch_case")

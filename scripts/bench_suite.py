@@ -50,6 +50,7 @@ def resnet50_eager():
         loss.backward()
         opt.step()
         opt.clear_grad()
+        np.asarray(loss._value)  # block: same sync rule as the jit bench
         return loss
 
     step()  # compile ops

@@ -103,3 +103,29 @@ def test_while_loop_eager_grads_unroll():
 def test_cond_single_branch_returns_none():
     x = paddle.to_tensor(np.array(-1.0, "f4"))
     assert cond(x > 0, lambda: x * 2) is None
+
+
+def test_async_task_on_all_gather():
+    import paddle_tpu.distributed as dist
+
+    x = paddle.to_tensor(np.ones(4, "f4"))
+    out = []
+    task = dist.all_gather(out, x, sync_op=False)
+    assert task.wait() and len(out) >= 1
+
+
+def test_while_loop_traced_dtype_mismatch_raises():
+    @jax.jit
+    def f(v):
+        from paddle_tpu.core.tensor import Tensor
+
+        i = Tensor(v, stop_gradient=True)
+        out = while_loop(
+            lambda i: i < 3,
+            lambda i: [i + 0.5],  # float out of int carry
+            [i],
+        )
+        return out[0]._value
+
+    with pytest.raises(TypeError, match="shape/dtype-stable"):
+        f(np.array(0, "i4"))

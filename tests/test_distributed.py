@@ -297,3 +297,30 @@ def test_dist_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(m.weight.numpy(), w_ref)
     # sharding preserved after load
     assert "sharding" in str(m.weight._value.sharding.spec)
+
+
+def test_async_collective_task_handles():
+    import paddle_tpu.distributed as dist
+
+    x = paddle.to_tensor(np.ones(4, "f4"))
+    task = dist.all_reduce(x, sync_op=False)
+    assert hasattr(task, "wait") and task.wait() and task.is_completed()
+    assert isinstance(dist.broadcast(x, src=0), type(x))  # sync returns tensor
+
+
+def test_nan_check_fires_inside_jit():
+    import jax
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.core import autograd
+
+    set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        def f(v):
+            with autograd.no_grad():
+                return Tensor(v, stop_gradient=True).log()._value
+
+        with pytest.raises(Exception, match="NaN/Inf"):
+            np.asarray(jax.jit(f)(np.array([-1.0], "f4")))
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
