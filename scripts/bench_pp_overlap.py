@@ -1,0 +1,93 @@
+"""Pipeline-parallel overlap evidence (round-1 verdict item #5's "Done"
+criterion: a pp bench showing overlap — step time per microbatch SHRINKS
+as microbatches amortize the pipeline bubble).
+
+Runs on the 8-device virtual CPU mesh with a compute-heavy stage stack
+(big matmuls so compute dominates Python scheduling). For a 1F1B
+schedule with S stages and m microbatches, ideal utilization is
+m / (m + S - 1); with NO overlap (stages strictly serialized) the
+per-microbatch time would be flat in m. We report per-microbatch step
+time at m=1 vs m=8 — a falling curve is overlap.
+
+    python scripts/bench_pp_overlap.py
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    # must run before any backend initialization
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.parallel import mesh as mesh_state
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineParallel,
+    )
+
+    D = 1024  # big matmuls: compute >> host scheduling
+    descs = [LayerDesc(nn.Linear, D, D) for _ in range(8)]
+
+    def run(acc_steps, iters=5, batch=32):
+        mesh_state.set_mesh(None)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 4,
+            "sharding_degree": 1,
+        }
+        strategy.pipeline_configs = {"accumulate_steps": acc_steps}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        pipe = PipelineLayer(layers=descs, num_stages=4,
+                             loss_fn=nn.MSELoss())
+        model = PipelineParallel(
+            pipe, fleet.get_hybrid_communicate_group(), strategy)
+        opt = paddle.optimizer.SGD(0.01, parameters=pipe.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(
+                batch * acc_steps, D).astype("f4"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(
+                batch * acc_steps, D).astype("f4"))
+
+        def step():
+            loss = model.train_batch([x, y], opt)
+            float(loss)  # block
+
+        step()  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step()
+        dt = (time.perf_counter() - t0) / iters
+        mesh_state.set_mesh(None)
+        return dt / acc_steps  # per-microbatch time
+
+    t1 = run(1)
+    t8 = run(8)
+    out = {
+        "metric": "pp4_per_microbatch_step_time_ms",
+        "m1_ms": round(t1 * 1000, 2),
+        "m8_ms": round(t8 * 1000, 2),
+        "overlap_speedup": round(t1 / t8, 2),
+        "ideal_1f1b_speedup": round((1 + 3) / (1 + 3 / 8), 2),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -218,12 +218,51 @@ def resnet50_jit():
             "value": round(batch / dt, 1), "unit": "img/s"}
 
 
+def llama_decode():
+    """Decode throughput: greedy generation with the KV-cache path, the
+    whole loop in one dispatch (prefill + lax.scan of token steps)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import generate_on_device
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            tensor_parallel=False,
+        )
+        batch, prompt, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, prompt, new = 2, 8, 8
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.astype("bfloat16")
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(1, cfg.vocab_size, (batch, prompt)))
+
+    def run():
+        out = generate_on_device(model, ids, max_new_tokens=new)
+        np.asarray(out._value)
+
+    run()  # compile
+    dt = _time_it(run, warmup=1, iters=3)
+    return {"metric": "llama_375m_decode_tokens_per_sec",
+            "value": round(batch * new / dt, 1), "unit": "tok/s",
+            "batch": batch, "new_tokens": new}
+
+
 CONFIGS = {
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,
     "ernie_engine": ernie_engine,
     "sd_unet": sd_unet,
+    "llama_decode": llama_decode,
 }
 
 
