@@ -109,3 +109,6 @@ from . import quantization  # noqa: E402
 from . import sparse  # noqa: E402
 from . import device  # noqa: E402
 from . import visualdl  # noqa: E402
+from . import distribution  # noqa: E402
+from . import signal  # noqa: E402
+from . import geometric  # noqa: E402

@@ -1,0 +1,372 @@
+"""paddle.distribution — probability distributions (reference:
+python/paddle/distribution/ — unverified, SURVEY.md §0).
+
+Built on jax.random / jax.scipy.stats through the dispatch seam:
+``log_prob``/``entropy``/``kl_divergence`` are differentiable taped ops;
+``sample`` draws from the framework RNG (``paddle.seed`` determinism);
+``rsample`` is the reparameterized (pathwise-differentiable) form where
+one exists.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.random import next_key
+from ..tensor._helpers import apply, ensure_tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Laplace", "Gumbel", "LogNormal",
+    "kl_divergence", "register_kl",
+]
+
+
+def _shape_of(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+    return tuple(sample_shape) + base
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return self.log_prob(value).exp()
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc, dtype="float32")
+        self.scale = ensure_tensor(scale, dtype="float32")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape_of(shape, self.loc._value, self.scale._value)
+        return apply(
+            lambda m, s: m + s * jax.random.normal(key, shp),
+            self.loc, self.scale, op_name="normal_rsample",
+        )
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, m, s: jax.scipy.stats.norm.logpdf(v, m, s),
+            value, self.loc, self.scale, op_name="normal_log_prob",
+        )
+
+    def entropy(self):
+        return apply(
+            lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            self.scale, op_name="normal_entropy",
+        )
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        self.loc = self._base.loc
+        self.scale = self._base.scale
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape).exp()
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return self._base.log_prob(value.log()) - value.log()
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low, dtype="float32")
+        self.high = ensure_tensor(high, dtype="float32")
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape_of(shape, self.low._value, self.high._value)
+        return apply(
+            lambda lo, hi: lo + (hi - lo) * jax.random.uniform(key, shp),
+            self.low, self.high, op_name="uniform_rsample",
+        )
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, lo, hi: jnp.where(
+                (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf
+            ),
+            value, self.low, self.high, op_name="uniform_log_prob",
+        )
+
+    def entropy(self):
+        return (self.high - self.low).log()
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc, dtype="float32")
+        self.scale = ensure_tensor(scale, dtype="float32")
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape_of(shape, self.loc._value, self.scale._value)
+        return apply(
+            lambda m, s: m + s * jax.random.laplace(key, shp),
+            self.loc, self.scale, op_name="laplace_rsample",
+        )
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, m, s: -jnp.abs(v - m) / s - jnp.log(2 * s),
+            value, self.loc, self.scale, op_name="laplace_log_prob",
+        )
+
+    def entropy(self):
+        return 1.0 + (2.0 * self.scale).log()
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc, dtype="float32")
+        self.scale = ensure_tensor(scale, dtype="float32")
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shp = _shape_of(shape, self.loc._value, self.scale._value)
+        return apply(
+            lambda m, s: m + s * jax.random.gumbel(key, shp),
+            self.loc, self.scale, op_name="gumbel_rsample",
+        )
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def fn(v, m, s):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply(fn, value, self.loc, self.scale,
+                     op_name="gumbel_log_prob")
+
+    def entropy(self):
+        return self.scale.log() + (1.0 + float(np.euler_gamma))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("Bernoulli: pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = ensure_tensor(probs, dtype="float32")
+        else:
+            self.probs = ensure_tensor(logits, dtype="float32").sigmoid()
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape_of(shape, self.probs._value)
+        return apply(
+            lambda p: jax.random.bernoulli(key, p, shp).astype(jnp.float32),
+            self.probs, op_name="bernoulli_sample",
+        )
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        eps = 1e-7
+
+        def fn(v, p):
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply(fn, value, self.probs, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        eps = 1e-7
+
+        def fn(p):
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return apply(fn, self.probs, op_name="bernoulli_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = ensure_tensor(logits, dtype="float32")
+
+    @property
+    def probs(self):
+        return apply(
+            lambda l: jax.nn.softmax(l, axis=-1), self.logits,
+            op_name="categorical_probs",
+        )
+
+    def sample(self, shape=()):
+        key = next_key()
+        return apply(
+            lambda l: jax.random.categorical(
+                key, l, shape=tuple(shape) + l.shape[:-1]
+            ),
+            self.logits, op_name="categorical_sample",
+        )
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def fn(l, v):
+            logp = jax.nn.log_softmax(l, axis=-1)
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+
+        return apply(fn, self.logits, value, op_name="categorical_log_prob")
+
+    def entropy(self):
+        def fn(l):
+            logp = jax.nn.log_softmax(l, axis=-1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+
+        return apply(fn, self.logits, op_name="categorical_entropy")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = ensure_tensor(alpha, dtype="float32")
+        self.beta = ensure_tensor(beta, dtype="float32")
+
+    def sample(self, shape=()):
+        key = next_key()
+        shp = _shape_of(shape, self.alpha._value, self.beta._value)
+        return apply(
+            lambda a, b: jax.random.beta(key, a, b, shp),
+            self.alpha, self.beta, op_name="beta_sample",
+        )
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, a, b: jax.scipy.stats.beta.logpdf(v, a, b),
+            value, self.alpha, self.beta, op_name="beta_log_prob",
+        )
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = ensure_tensor(concentration, dtype="float32")
+
+    def sample(self, shape=()):
+        key = next_key()
+        return apply(
+            lambda c: jax.random.dirichlet(key, c, tuple(shape)),
+            self.concentration, op_name="dirichlet_sample",
+        )
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, c: jax.scipy.stats.dirichlet.logpdf(v.T, c),
+            value, self.concentration, op_name="dirichlet_log_prob",
+        )
+
+
+# -- KL divergence registry ---------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for "
+            f"({type(p).__name__}, {type(q).__name__})"
+        )
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def fn(m1, s1, m2, s2):
+        var_ratio = (s1 / s2) ** 2
+        t1 = ((m1 - m2) / s2) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return apply(fn, p.loc, p.scale, q.loc, q.scale, op_name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def fn(pl, ph, ql, qh):
+        inside = (ql <= pl) & (ph <= qh)
+        return jnp.where(
+            inside, jnp.log((qh - ql) / (ph - pl)), jnp.inf
+        )
+
+    return apply(fn, p.low, p.high, q.low, q.high, op_name="kl_uniform")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def fn(lp, lq):
+        a = jax.nn.log_softmax(lp, axis=-1)
+        b = jax.nn.log_softmax(lq, axis=-1)
+        return (jnp.exp(a) * (a - b)).sum(-1)
+
+    return apply(fn, p.logits, q.logits, op_name="kl_categorical")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qq):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qq = jnp.clip(qq, eps, 1 - eps)
+        return pp * jnp.log(pp / qq) + (1 - pp) * jnp.log(
+            (1 - pp) / (1 - qq))
+
+    return apply(fn, p.probs, q.probs, op_name="kl_bernoulli")

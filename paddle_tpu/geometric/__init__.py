@@ -1,0 +1,109 @@
+"""paddle.geometric — graph message passing / segment ops (reference:
+python/paddle/geometric/ — unverified, SURVEY.md §0).
+
+Segment reductions map 1:1 onto ``jax.ops.segment_*`` (TPU lowers them
+to sorted scatters); message passing (``send_u_recv`` etc.) is
+gather-by-src → segment-reduce-by-dst, which XLA fuses. All ops are
+taped (differentiable through gather/scatter). Empty segments reduce to
+0 for every reduce_op, matching the reference."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor._helpers import apply, ensure_tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv",
+]
+
+
+def _reduce(msgs, ids, n, reduce_op):
+    """Shared segment reduction with reference empty-bucket semantics."""
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones(ids.shape, msgs.dtype), ids, num_segments=n
+        )
+        shape = (-1,) + (1,) * (msgs.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    jfn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}[reduce_op]
+    out = jfn(msgs, ids, num_segments=n)
+    if reduce_op in ("max", "min"):
+        # empty buckets come back as +/-inf; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+def _num_segments(ids, given):
+    if given is not None:
+        return int(given)
+    v = ids._value
+    return int(jnp.max(v)) + 1 if v.size else 0
+
+
+def _make_segment(reduce_op):
+    def op(data, segment_ids, name=None, num_segments=None):
+        data = ensure_tensor(data)
+        segment_ids = ensure_tensor(segment_ids)
+        n = _num_segments(segment_ids, num_segments)
+        return apply(
+            lambda d, ids: _reduce(d, ids, n, reduce_op),
+            data, segment_ids, op_name=f"segment_{reduce_op}",
+        )
+
+    op.__name__ = f"segment_{reduce_op}"
+    op.__doc__ = (
+        f"paddle.geometric.segment_{reduce_op}(data, segment_ids): "
+        f"{reduce_op}-reduce rows into segment buckets."
+    )
+    return op
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather rows of ``x`` at ``src_index``, reduce into ``dst_index``
+    buckets (reference paddle.geometric.send_u_recv)."""
+    x = ensure_tensor(x)
+    src_index = ensure_tensor(src_index)
+    dst_index = ensure_tensor(dst_index)
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size)
+
+    def fn(xv, src, dst):
+        return _reduce(xv[src], dst, n, reduce_op)
+
+    return apply(fn, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Like send_u_recv but the message combines node features with edge
+    features ``y`` first (add/sub/mul/div)."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src_index = ensure_tensor(src_index)
+    dst_index = ensure_tensor(dst_index)
+    combine = {
+        "add": jnp.add, "sub": jnp.subtract,
+        "mul": jnp.multiply, "div": jnp.divide,
+    }.get(message_op)
+    if combine is None:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size)
+
+    def fn(xv, ev, src, dst):
+        return _reduce(combine(xv[src], ev), dst, n, reduce_op)
+
+    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv")

@@ -7,7 +7,7 @@ line per config; results are recorded in BENCH_NOTES.md.
     PYTHONPATH=. python scripts/bench_suite.py [config ...]
 
 Configs: resnet50_eager | resnet50_jit | gpt2_jit | ernie_engine |
-sd_unet  (the Llama MFU headline lives in bench.py)
+sd_unet | llama_decode  (the Llama MFU headline lives in bench.py)
 """
 from __future__ import annotations
 

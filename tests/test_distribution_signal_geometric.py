@@ -1,0 +1,197 @@
+"""paddle.distribution / paddle.signal / paddle.geometric namespaces
+(SURVEY.md §2.4 API breadth), scipy/numpy-oracle checked."""
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestDistributions:
+    def test_normal_logprob_entropy_kl(self):
+        n = D.Normal(_t(np.float32(1.0)), _t(np.float32(2.0)))
+        v = np.array([0.0, 1.0, 3.0], "f4")
+        np.testing.assert_allclose(
+            np.asarray(n.log_prob(_t(v))._value),
+            sps.norm.logpdf(v, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(n.entropy()), sps.norm.entropy(1.0, 2.0), rtol=1e-5)
+        m = D.Normal(_t(np.float32(0.0)), _t(np.float32(1.0)))
+        # KL(N(1,2)||N(0,1)) analytic
+        expect = 0.5 * (4 + 1 - 1 - np.log(4))
+        np.testing.assert_allclose(
+            float(D.kl_divergence(n, m)), expect, rtol=1e-5)
+
+    def test_normal_rsample_reparameterized_grads(self):
+        paddle.seed(0)
+        loc = _t(np.float32(0.5))
+        loc.stop_gradient = False
+        dist = D.Normal(loc, _t(np.float32(1.0)))
+        s = dist.rsample([256])
+        (g,) = paddle.grad(s.mean(), [loc])
+        np.testing.assert_allclose(float(g), 1.0, rtol=1e-5)
+
+    def test_sampling_statistics(self):
+        paddle.seed(0)
+        u = D.Uniform(_t(np.float32(-1.0)), _t(np.float32(3.0)))
+        s = np.asarray(u.sample([4000])._value)
+        assert -1 <= s.min() and s.max() < 3
+        assert abs(s.mean() - 1.0) < 0.1
+
+        b = D.Bernoulli(probs=_t(np.float32(0.3)))
+        s = np.asarray(b.sample([4000])._value)
+        assert abs(s.mean() - 0.3) < 0.05
+
+    def test_categorical(self):
+        logits = _t(np.log(np.array([0.1, 0.2, 0.7], "f4")))
+        c = D.Categorical(logits)
+        np.testing.assert_allclose(
+            np.asarray(c.probs._value), [0.1, 0.2, 0.7], rtol=1e-5)
+        np.testing.assert_allclose(
+            float(c.log_prob(_t(np.int64(2)))), np.log(0.7), rtol=1e-5)
+        expect_h = -(np.array([0.1, 0.2, 0.7])
+                     * np.log([0.1, 0.2, 0.7])).sum()
+        np.testing.assert_allclose(float(c.entropy()), expect_h, rtol=1e-5)
+        c2 = D.Categorical(_t(np.zeros(3, "f4")))
+        kl = float(D.kl_divergence(c, c2))
+        assert kl > 0
+
+    def test_beta_dirichlet_gumbel_laplace(self):
+        bt = D.Beta(_t(np.float32(2.0)), _t(np.float32(3.0)))
+        v = np.array([0.2, 0.5], "f4")
+        np.testing.assert_allclose(
+            np.asarray(bt.log_prob(_t(v))._value),
+            sps.beta.logpdf(v, 2.0, 3.0), rtol=1e-4)
+        np.testing.assert_allclose(float(bt.mean), 0.4, rtol=1e-6)
+
+        dr = D.Dirichlet(_t(np.array([1.0, 2.0, 3.0], "f4")))
+        x = np.array([0.2, 0.3, 0.5], "f4")
+        np.testing.assert_allclose(
+            float(dr.log_prob(_t(x))),
+            sps.dirichlet.logpdf(x, [1.0, 2.0, 3.0]), rtol=1e-4)
+
+        lp = D.Laplace(_t(np.float32(0.0)), _t(np.float32(1.0)))
+        np.testing.assert_allclose(
+            float(lp.log_prob(_t(np.float32(1.0)))),
+            sps.laplace.logpdf(1.0), rtol=1e-5)
+
+        gm = D.Gumbel(_t(np.float32(0.0)), _t(np.float32(1.0)))
+        np.testing.assert_allclose(
+            float(gm.log_prob(_t(np.float32(0.5)))),
+            sps.gumbel_r.logpdf(0.5), rtol=1e-4)
+
+
+class TestSignal:
+    def test_stft_matches_manual_dft(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 256).astype("f4")
+        n_fft, hop = 64, 16
+        spec = paddle.signal.stft(
+            _t(x), n_fft, hop_length=hop, center=False)
+        got = np.asarray(spec._value)
+        # manual: frame + rfft
+        n_frames = (256 - n_fft) // hop + 1
+        for f in range(0, n_frames, 3):
+            ref = np.fft.rfft(x[0, f * hop: f * hop + n_fft])
+            np.testing.assert_allclose(
+                got[0, :, f], ref.astype("c8"), rtol=1e-3, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(192).astype("f4")
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype("f4")
+        spec = paddle.signal.stft(
+            _t(x), n_fft, hop_length=hop, window=_t(win), center=True)
+        back = paddle.signal.istft(
+            spec, n_fft, hop_length=hop, window=_t(win), center=True,
+            length=192)
+        np.testing.assert_allclose(
+            np.asarray(back._value), x, rtol=1e-3, atol=1e-3)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = _t(np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], "f4"))
+        ids = _t(np.array([0, 0, 1, 1], "i4"))
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_sum(data, ids)._value),
+            [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_mean(data, ids)._value),
+            [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_max(data, ids)._value),
+            [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_min(data, ids)._value),
+            [[1, 2], [5, 6]])
+
+    def test_send_u_recv_and_grads(self):
+        x = _t(np.array([[1.0], [2.0], [3.0]], "f4"))
+        x.stop_gradient = False
+        src = _t(np.array([0, 1, 2, 0], "i4"))
+        dst = _t(np.array([1, 2, 0, 2], "i4"))
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(
+            np.asarray(out._value), [[3.0], [1.0], [3.0]])
+        out.sum().backward()
+        # node 0 sent twice, others once
+        np.testing.assert_allclose(
+            np.asarray(x.grad._value), [[2.0], [1.0], [1.0]])
+
+    def test_send_ue_recv_mean_and_empty_buckets(self):
+        x = _t(np.array([[1.0], [2.0]], "f4"))
+        e = _t(np.array([[10.0], [20.0]], "f4"))
+        src = _t(np.array([0, 1], "i4"))
+        dst = _t(np.array([0, 0], "i4"))
+        out = paddle.geometric.send_ue_recv(
+            x, e, src, dst, message_op="add", reduce_op="mean", out_size=2)
+        np.testing.assert_allclose(
+            np.asarray(out._value), [[16.5], [0.0]])
+        out2 = paddle.geometric.send_u_recv(
+            x, src, dst, reduce_op="max", out_size=2)
+        np.testing.assert_allclose(
+            np.asarray(out2._value), [[2.0], [0.0]])  # empty bucket → 0
+
+
+def test_segment_max_empty_buckets_zeroed():
+    data = _t(np.array([[1.0], [2.0]], "f4"))
+    ids = _t(np.array([0, 0], "i4"))
+    out = paddle.geometric.segment_max(data, ids, num_segments=3)
+    np.testing.assert_allclose(
+        np.asarray(out._value), [[2.0], [0.0], [0.0]])
+    out = paddle.geometric.segment_min(data, ids, num_segments=3)
+    np.testing.assert_allclose(
+        np.asarray(out._value), [[1.0], [0.0], [0.0]])
+
+
+def test_segment_name_kwarg_accepted():
+    data = _t(np.ones((2, 2), "f4"))
+    ids = _t(np.array([0, 1], "i4"))
+    paddle.geometric.segment_sum(data, ids, name="s")
+
+
+def test_stft_rectangular_win_length():
+    rng = np.random.RandomState(2)
+    x = rng.randn(128).astype("f4")
+    n_fft, win, hop = 64, 32, 16
+    spec = paddle.signal.stft(
+        _t(x), n_fft, hop_length=hop, win_length=win, center=False)
+    got = np.asarray(spec._value)[:, 0]
+    # reference: rectangular win_length window centered in the frame
+    w = np.zeros(n_fft, "f4")
+    w[(n_fft - win) // 2: (n_fft - win) // 2 + win] = 1.0
+    ref = np.fft.rfft(x[:n_fft] * w)
+    np.testing.assert_allclose(got, ref.astype("c8"), rtol=1e-3, atol=1e-3)
+
+
+def test_istft_return_complex_validation():
+    spec = paddle.signal.stft(_t(np.random.randn(128).astype("f4")), 32)
+    with pytest.raises(ValueError, match="onesided"):
+        paddle.signal.istft(spec, 32, return_complex=True, onesided=True)
