@@ -81,3 +81,148 @@ class PyLayer:
                 t._slot = slot
                 node.outputs.append((slot, tuple(t._value.shape), t._value.dtype))
         return out
+
+
+def _functionalize(func):
+    """Wrap a Tensor-level callable as a pure jax-value function with the
+    output pytree preserved (Tensors become raw leaves)."""
+    from ..core.tensor import Tensor
+    from ..core import autograd as ag
+    import jax as _jax
+
+    def pure(*vals):
+        with ag.no_grad():
+            out = func(*[Tensor(v, stop_gradient=True) for v in vals])
+        return _jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
+
+    return pure
+
+
+def _run_taped(fn, xs_list, op_name, create_graph):
+    """Evaluate a pure jax transform through the dispatch seam: the
+    result is ON the tape when inputs are tracked, which is what makes
+    create_graph (higher-order use) work; create_graph=False detaches."""
+    from ..core.dispatch import apply as dispatch_apply
+    from ..core.tensor import Tensor
+    import jax as _jax
+
+    out = dispatch_apply(fn, *xs_list, op_name=op_name)
+    if not create_graph:
+        out = _jax.tree_util.tree_map(
+            lambda t: Tensor(t._value, stop_gradient=True)
+            if isinstance(t, Tensor) else t,
+            out, is_leaf=lambda x: isinstance(x, Tensor),
+        )
+    return out
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """paddle.autograd.jacobian — dense Jacobian of func at xs via
+    jax.jacrev over the functionalized graph (reference:
+    python/paddle/autograd/functional.py — unverified).
+
+    ``create_graph=True`` keeps the Jacobian on the tape (differentiable
+    again). Unused inputs yield zero blocks (this backend cannot detect
+    graph non-participation, so ``allow_unused`` has no effect)."""
+    from ..core.tensor import Tensor
+    import jax as _jax
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func)
+    argnums = tuple(range(len(xs_list)))
+
+    def fn(*vals):
+        jac = _jax.jacrev(pure, argnums=argnums)(*vals)
+        return jac[0] if single else jac
+
+    return _run_taped(fn, xs_list, "jacobian", create_graph)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """paddle.autograd.hessian — Hessian of a scalar-valued func (see
+    jacobian for create_graph/allow_unused semantics)."""
+    from ..core.tensor import Tensor
+    import jax as _jax
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func)
+    argnums = tuple(range(len(xs_list)))
+
+    def fn(*vals):
+        hes = _jax.hessian(pure, argnums=argnums)(*vals)
+        return hes[0][0] if single else hes
+
+    return _run_taped(fn, xs_list, "hessian", create_graph)
+
+
+def vjp(func, xs, v=None):
+    """paddle.autograd.vjp → (outputs, vjp_result); pytree outputs keep
+    their structure, and ``v`` must mirror it."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    from ..core.tensor import Tensor
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func)
+    out, vjp_fn = _jax.vjp(pure, *[t._value for t in xs_list])
+    if v is None:
+        cot = _jax.tree_util.tree_map(_jnp.ones_like, out)
+    else:
+        cot = _jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else _jnp.asarray(t),
+            v, is_leaf=lambda x: isinstance(x, Tensor),
+        )
+        n_out = len(_jax.tree_util.tree_leaves(out))
+        n_v = len(_jax.tree_util.tree_leaves(cot))
+        if n_out != n_v:
+            raise ValueError(
+                f"vjp: v has {n_v} leaves but func produced {n_out} outputs"
+            )
+    grads = vjp_fn(cot)
+
+    def wrap(tree):
+        return _jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), tree
+        )
+
+    return wrap(out), (wrap(grads[0]) if single else tuple(
+        wrap(g) for g in grads))
+
+
+def jvp(func, xs, v=None):
+    """paddle.autograd.jvp → (outputs, jvp_result)."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    from ..core.tensor import Tensor
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    pure = _functionalize(func)
+    primals = [t._value for t in xs_list]
+    if v is None:
+        tangents = [_jnp.ones_like(p) for p in primals]
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        if len(v_list) != len(primals):
+            raise ValueError(
+                f"jvp: v has {len(v_list)} entries for {len(primals)} inputs"
+            )
+        tangents = [t._value if isinstance(t, Tensor) else _jnp.asarray(t)
+                    for t in v_list]
+    out, tang = _jax.jvp(pure, tuple(primals), tuple(tangents))
+
+    def wrap(tree):
+        return _jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), tree
+        )
+
+    return wrap(out), wrap(tang)
+
+
+__all__ += ["jacobian", "hessian", "vjp", "jvp"]

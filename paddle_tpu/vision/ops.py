@@ -1,0 +1,200 @@
+"""paddle.vision.ops — detection ops (reference:
+python/paddle/vision/ops.py — unverified, SURVEY.md §0).
+
+TPU-shaped forms: ``nms`` is the O(N²) IoU matrix + a ``lax.scan``
+suppression sweep (static shapes — no data-dependent compaction inside
+the kernel; callers slice by the returned count), ``box_iou`` and
+``box_coder`` are pure elementwise/matrix ops, ``roi_align`` gathers
+bilinear samples (differentiable through the gather)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor._helpers import Tensor, apply, ensure_tensor
+
+__all__ = ["box_iou", "nms", "roi_align", "box_coder"]
+
+
+def _iou_matrix(a, b):
+    """(N,4),(M,4) xyxy → (N,M) IoU."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    return apply(
+        _iou_matrix, ensure_tensor(boxes1), ensure_tensor(boxes2),
+        op_name="box_iou",
+    )
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS. Returns kept indices sorted by score (reference
+    paddle.vision.ops.nms). With ``category_idxs`` boxes only suppress
+    within their own category (batched-class NMS via coordinate
+    offsetting)."""
+    boxes = ensure_tensor(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores_t = None
+    else:
+        scores_t = ensure_tensor(scores)
+    if category_idxs is not None:
+        category_idxs = ensure_tensor(category_idxs)
+
+    def fn(bv, *rest):
+        sv = rest[0] if scores_t is not None else jnp.arange(
+            n, 0, -1, dtype=jnp.float32)
+        if category_idxs is not None:
+            cat = rest[-1]
+            # offset each category into a disjoint coordinate region so
+            # cross-category IoU is zero (classic batched-NMS trick)
+            span = jnp.max(bv) - jnp.min(bv) + 1
+            bv = bv + (cat.astype(bv.dtype) * span)[:, None]
+        order = jnp.argsort(-sv)
+        bo = bv[order]
+        iou = _iou_matrix(bo, bo)
+
+        def body(keep, i):
+            # suppressed if any higher-scoring KEPT box overlaps > thr
+            over = (iou[i] > iou_threshold) & keep & (
+                jnp.arange(n) < i)
+            ki = ~jnp.any(over)
+            return keep.at[i].set(ki), None
+
+        keep0 = jnp.ones((n,), bool)
+        keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+        kept_sorted = order[jnp.nonzero(keep[jnp.arange(n)], size=n,
+                                        fill_value=-1)[0]]
+        count = keep.sum()
+        return kept_sorted, count
+
+    args = [boxes]
+    if scores_t is not None:
+        args.append(scores_t)
+    if category_idxs is not None:
+        args.append(category_idxs)
+    kept, count = apply(fn, *args, op_name="nms")
+    k = int(count)
+    idx = kept[:k]
+    if top_k is not None:
+        idx = idx[: int(top_k)]
+    return idx
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign over NCHW features; boxes (R, 4) xyxy in input coords,
+    boxes_num (B,) rois per image."""
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    boxes_num = ensure_tensor(boxes_num)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def fn(feat, bx, bnum):
+        b, c, h, w = feat.shape
+        # map each roi to its image index
+        img_idx = jnp.repeat(
+            jnp.arange(bnum.shape[0]), bnum,
+            total_repeat_length=bx.shape[0],
+        )
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-4)
+        rh = jnp.maximum(y2 - y1, 1e-4)
+        # sample grid: (R, oh*ratio) x (R, ow*ratio)
+        gy = (y1[:, None]
+              + rh[:, None] * (jnp.arange(oh * ratio) + 0.5) / (oh * ratio))
+        gx = (x1[:, None]
+              + rw[:, None] * (jnp.arange(ow * ratio) + 0.5) / (ow * ratio))
+
+        def bilinear(img, ys, xs):
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(ys - y0, 0, 1)
+            wx = jnp.clip(xs - x0, 0, 1)
+            # img: (C, H, W); grids: (oh*r, ow*r)
+            g = lambda yy, xx: img[:, yy[:, None], xx[None, :]]
+            v = ((1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                 * g(y0, x0)
+                 + (1 - wy)[None, :, None] * wx[None, None, :] * g(y0, x1i)
+                 + wy[None, :, None] * (1 - wx)[None, None, :] * g(y1i, x0)
+                 + wy[None, :, None] * wx[None, None, :] * g(y1i, x1i))
+            return v  # (C, oh*r, ow*r)
+
+        def per_roi(i):
+            img = feat[img_idx[i]]
+            v = bilinear(img, gy[i], gx[i])
+            v = v.reshape(c, oh, ratio, ow, ratio)
+            return v.mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(bx.shape[0]))
+
+    return apply(fn, x, boxes, boxes_num, op_name="roi_align")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    prior_box = ensure_tensor(prior_box)
+    target_box = ensure_tensor(target_box)
+    if not isinstance(prior_box_var, (int, float, list, tuple)):
+        prior_box_var = ensure_tensor(prior_box_var)
+
+    norm = 0.0 if box_normalized else 1.0
+
+    def centers(b):
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        cx = b[..., 0] + w * 0.5
+        cy = b[..., 1] + h * 0.5
+        return cx, cy, w, h
+
+    def fn(pb, tb, *maybe_var):
+        var = (maybe_var[0] if maybe_var
+               else jnp.asarray(prior_box_var
+                                if isinstance(prior_box_var,
+                                              (list, tuple))
+                                else [1.0, 1.0, 1.0, 1.0],
+                                jnp.float32))
+        pcx, pcy, pw, ph = centers(pb)
+        if code_type == "encode_center_size":
+            tcx, tcy, tw, th = centers(tb)
+            out = jnp.stack([
+                (tcx - pcx) / pw, (tcy - pcy) / ph,
+                jnp.log(tw / pw), jnp.log(th / ph),
+            ], axis=-1)
+            return out / var
+        # decode_center_size
+        d = tb * var
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([
+            cx - w * 0.5, cy - h * 0.5,
+            cx + w * 0.5 - norm, cy + h * 0.5 - norm,
+        ], axis=-1)
+
+    args = [prior_box, target_box]
+    if isinstance(prior_box_var, Tensor):
+        args.append(prior_box_var)
+    return apply(fn, *args, op_name="box_coder")

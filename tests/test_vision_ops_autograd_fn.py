@@ -1,0 +1,112 @@
+"""paddle.vision.ops (nms/box ops/roi_align) + functional autograd
+(jacobian/hessian/vjp/jvp)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_box_iou():
+    a = _t(np.array([[0, 0, 2, 2], [0, 0, 1, 1]], "f4"))
+    b = _t(np.array([[1, 1, 2, 2]], "f4"))
+    iou = np.asarray(paddle.vision.ops.box_iou(a, b)._value)
+    np.testing.assert_allclose(iou, [[0.25], [0.0]], atol=1e-6)
+
+
+def test_nms_basic_and_scores():
+    boxes = np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], "f4")
+    scores = np.array([0.9, 0.8, 0.7], "f4")
+    keep = paddle.vision.ops.nms(_t(boxes), 0.5, scores=_t(scores))
+    np.testing.assert_array_equal(np.asarray(keep._value), [0, 2])
+    # flipping scores keeps box 1 instead of 0
+    keep2 = paddle.vision.ops.nms(
+        _t(boxes), 0.5, scores=_t(scores[::-1].copy()))
+    np.testing.assert_array_equal(np.asarray(keep2._value), [2, 1])
+
+
+def test_nms_categories_do_not_suppress_each_other():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], "f4")
+    scores = np.array([0.9, 0.8], "f4")
+    cats = np.array([0, 1], "i4")
+    keep = paddle.vision.ops.nms(
+        _t(boxes), 0.5, scores=_t(scores), category_idxs=_t(cats),
+        categories=[0, 1])
+    assert len(np.asarray(keep._value)) == 2
+
+
+def test_roi_align_constant_field():
+    # constant feature map → every aligned cell equals the constant
+    feat = np.full((1, 3, 8, 8), 5.0, "f4")
+    boxes = np.array([[1.0, 1.0, 5.0, 5.0]], "f4")
+    out = paddle.vision.ops.roi_align(
+        _t(feat), _t(boxes), _t(np.array([1], "i4")), output_size=2)
+    assert out.shape == [1, 3, 2, 2]
+    np.testing.assert_allclose(np.asarray(out._value), 5.0, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "f4")
+    targets = np.array([[1, 1, 9, 9], [6, 4, 14, 16]], "f4")
+    enc = paddle.vision.ops.box_coder(
+        _t(priors), [1.0, 1.0, 1.0, 1.0], _t(targets))
+    dec = paddle.vision.ops.box_coder(
+        _t(priors), [1.0, 1.0, 1.0, 1.0], enc,
+        code_type="decode_center_size")
+    np.testing.assert_allclose(
+        np.asarray(dec._value), targets, rtol=1e-4, atol=1e-4)
+
+
+def test_functional_jacobian_hessian():
+    x = _t(np.array([1.0, 2.0], "f4"))
+    J = paddle.autograd.jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(
+        np.asarray(J._value), np.diag([2.0, 4.0]), rtol=1e-6)
+    H = paddle.autograd.hessian(lambda t: (t ** 3).sum(), x)
+    np.testing.assert_allclose(
+        np.asarray(H._value), np.diag([6.0, 12.0]), rtol=1e-6)
+
+
+def test_functional_vjp_jvp():
+    x = _t(np.array([1.0, 2.0], "f4"))
+    out, g = paddle.autograd.vjp(
+        lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(float(out), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g._value), [2.0, 4.0], rtol=1e-6)
+    out, tang = paddle.autograd.jvp(
+        lambda t: (t * t).sum(), x, v=_t(np.array([1.0, 0.0], "f4")))
+    np.testing.assert_allclose(float(tang), 2.0, rtol=1e-6)
+
+
+def test_jacobian_create_graph_is_taped():
+    x = _t(np.array([1.0, 2.0], "f4"))
+    x.stop_gradient = False
+    J = paddle.autograd.jacobian(lambda t: t * t, x, create_graph=True)
+    assert not J.stop_gradient
+    # d/dx tr(J) = d/dx (2x_0 + 2x_1) = [2, 2]
+    (g,) = paddle.grad(paddle.trace(J), [x])
+    np.testing.assert_allclose(np.asarray(g._value), [2.0, 2.0], rtol=1e-6)
+    # default: detached
+    J2 = paddle.autograd.jacobian(lambda t: t * t, x)
+    assert J2.stop_gradient
+
+
+def test_vjp_leaf_count_validation():
+    x = _t(np.array([1.0], "f4"))
+    with pytest.raises(ValueError, match="leaves"):
+        paddle.autograd.vjp(
+            lambda t: (t * t).sum(), x,
+            v=[_t(np.float32(1.0)), _t(np.float32(2.0))],
+        )
+
+
+def test_vjp_multi_input_returns_tuple():
+    x = _t(np.array([1.0], "f4"))
+    y = _t(np.array([2.0], "f4"))
+    out, grads = paddle.autograd.vjp(lambda a, b: (a * b).sum(), [x, y])
+    assert isinstance(grads, tuple) and len(grads) == 2
+    np.testing.assert_allclose(float(grads[0]), 2.0, rtol=1e-6)
