@@ -112,3 +112,4 @@ from . import visualdl  # noqa: E402
 from . import distribution  # noqa: E402
 from . import signal  # noqa: E402
 from . import geometric  # noqa: E402
+from . import audio  # noqa: E402

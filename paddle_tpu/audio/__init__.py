@@ -1,0 +1,7 @@
+"""paddle.audio (reference: python/paddle/audio/ — unverified, SURVEY.md
+§0): spectrogram/mel/MFCC features over the framework's signal stack."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import (  # noqa: F401
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
+)

@@ -1,0 +1,147 @@
+"""paddle.audio.functional (reference:
+python/paddle/audio/functional/ — unverified, SURVEY.md §0): window
+generation, mel filterbanks, DCT matrices, dB conversion — all pure
+jnp/numpy math feeding the TPU spectrogram pipeline."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor._helpers import Tensor, apply, ensure_tensor
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "compute_fbank_matrix", "create_dct", "power_to_db", "fft_frequencies",
+]
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """'hann' | 'hamming' | 'blackman' | 'bohman' | ('gaussian', std) |
+    ('kaiser', beta) — periodic (fftbins=True) or symmetric."""
+    name, args = (window, ()) if isinstance(window, str) else (
+        window[0], tuple(window[1:]))
+    n = win_length + (0 if fftbins else -1)
+    t = np.arange(win_length) / max(n, 1)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t)
+             + 0.08 * np.cos(4 * np.pi * t))
+    elif name == "bohman":
+        x = np.abs(2 * t - 1)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name == "gaussian":
+        std = args[0] if args else 1.0
+        m = (win_length - 1) / 2
+        w = np.exp(-0.5 * ((np.arange(win_length) - m) / std) ** 2)
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.kaiser(win_length, beta)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w, jnp.dtype(dtype)))
+
+
+def hz_to_mel(freq, htk=False):
+    """Slaney (default) or HTK mel scale; accepts scalars or Tensors."""
+    scalar = not isinstance(freq, Tensor)
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                   np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(
+            f >= min_log_hz,
+            min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+            mel,
+        )
+    return float(mel) if scalar and mel.ndim == 0 else Tensor(
+        jnp.asarray(mel, jnp.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                   np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(
+            m >= min_log_mel,
+            min_log_hz * np.exp(logstep * (m - min_log_mel)),
+            hz,
+        )
+    return float(hz) if scalar and hz.ndim == 0 else Tensor(
+        jnp.asarray(hz, jnp.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    hz = mel_to_hz(Tensor(jnp.asarray(mels, jnp.float32)), htk)._value
+    return Tensor(jnp.asarray(hz, jnp.dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2, n_fft // 2 + 1), jnp.dtype(dtype)))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """(n_mels, n_fft//2 + 1) triangular mel filterbank."""
+    f_max = f_max or sr / 2
+    fft_f = np.asarray(fft_frequencies(sr, n_fft)._value)
+    mel_f = np.asarray(mel_frequencies(
+        n_mels + 2, f_min, f_max, htk)._value)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / np.maximum(fdiff[:-1, None], 1e-10)
+    upper = ramps[2:] / np.maximum(fdiff[1:, None], 1e-10)
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.dtype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """(n_mels, n_mfcc) DCT-II basis."""
+    k = np.arange(n_mfcc)[None, :]
+    n = np.arange(n_mels)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.dtype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    x = ensure_tensor(spect)
+
+    def fn(v):
+        db = 10.0 * jnp.log10(jnp.maximum(v, amin))
+        db -= 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+        if top_db is not None:
+            db = jnp.maximum(db, db.max() - top_db)
+        return db
+
+    return apply(fn, x, op_name="power_to_db")

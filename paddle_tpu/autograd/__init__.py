@@ -162,7 +162,8 @@ def hessian(func, xs, create_graph=False, allow_unused=False):
 
 def vjp(func, xs, v=None):
     """paddle.autograd.vjp → (outputs, vjp_result); pytree outputs keep
-    their structure, and ``v`` must mirror it."""
+    their structure, ``v`` must mirror it, and both results stay on the
+    tape (differentiable again) when inputs are tracked."""
     import jax as _jax
     import jax.numpy as _jnp
     from ..core.tensor import Tensor
@@ -170,33 +171,35 @@ def vjp(func, xs, v=None):
     single = isinstance(xs, Tensor)
     xs_list = [xs] if single else list(xs)
     pure = _functionalize(func)
-    out, vjp_fn = _jax.vjp(pure, *[t._value for t in xs_list])
-    if v is None:
-        cot = _jax.tree_util.tree_map(_jnp.ones_like, out)
-    else:
-        cot = _jax.tree_util.tree_map(
+
+    if v is not None:
+        cot_tree = _jax.tree_util.tree_map(
             lambda t: t._value if isinstance(t, Tensor) else _jnp.asarray(t),
             v, is_leaf=lambda x: isinstance(x, Tensor),
         )
+    else:
+        cot_tree = None
+
+    def fn(*vals):
+        out, vjp_fn = _jax.vjp(pure, *vals)
+        cot = (_jax.tree_util.tree_map(_jnp.ones_like, out)
+               if cot_tree is None else cot_tree)
         n_out = len(_jax.tree_util.tree_leaves(out))
         n_v = len(_jax.tree_util.tree_leaves(cot))
         if n_out != n_v:
             raise ValueError(
                 f"vjp: v has {n_v} leaves but func produced {n_out} outputs"
             )
-    grads = vjp_fn(cot)
+        grads = vjp_fn(cot)
+        return out, (grads[0] if single else tuple(grads))
 
-    def wrap(tree):
-        return _jax.tree_util.tree_map(
-            lambda a: Tensor(a, stop_gradient=True), tree
-        )
-
-    return wrap(out), (wrap(grads[0]) if single else tuple(
-        wrap(g) for g in grads))
+    out, grads = _run_taped(fn, xs_list, "vjp", create_graph=True)
+    return out, grads
 
 
 def jvp(func, xs, v=None):
-    """paddle.autograd.jvp → (outputs, jvp_result)."""
+    """paddle.autograd.jvp → (outputs, jvp_result); results stay on the
+    tape when inputs are tracked."""
     import jax as _jax
     import jax.numpy as _jnp
     from ..core.tensor import Tensor
@@ -204,25 +207,26 @@ def jvp(func, xs, v=None):
     single = isinstance(xs, Tensor)
     xs_list = [xs] if single else list(xs)
     pure = _functionalize(func)
-    primals = [t._value for t in xs_list]
-    if v is None:
-        tangents = [_jnp.ones_like(p) for p in primals]
-    else:
+    if v is not None:
         v_list = v if isinstance(v, (list, tuple)) else [v]
-        if len(v_list) != len(primals):
+        if len(v_list) != len(xs_list):
             raise ValueError(
-                f"jvp: v has {len(v_list)} entries for {len(primals)} inputs"
+                f"jvp: v has {len(v_list)} entries for {len(xs_list)} inputs"
             )
-        tangents = [t._value if isinstance(t, Tensor) else _jnp.asarray(t)
-                    for t in v_list]
-    out, tang = _jax.jvp(pure, tuple(primals), tuple(tangents))
-
-    def wrap(tree):
-        return _jax.tree_util.tree_map(
-            lambda a: Tensor(a, stop_gradient=True), tree
+        tangents = tuple(
+            t._value if isinstance(t, Tensor) else _jnp.asarray(t)
+            for t in v_list
         )
+    else:
+        tangents = None
 
-    return wrap(out), wrap(tang)
+    def fn(*vals):
+        tang_in = (tuple(_jnp.ones_like(p) for p in vals)
+                   if tangents is None else tangents)
+        return _jax.jvp(pure, tuple(vals), tang_in)
+
+    out, tang = _run_taped(fn, xs_list, "jvp", create_graph=True)
+    return out, tang
 
 
 __all__ += ["jacobian", "hessian", "vjp", "jvp"]

@@ -43,6 +43,10 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     offsetting)."""
     boxes = ensure_tensor(boxes)
     n = boxes.shape[0]
+    if n == 0:  # routine in detection pipelines (no boxes above threshold)
+        import jax.numpy as _jnp
+
+        return Tensor(_jnp.zeros((0,), _jnp.int32), stop_gradient=True)
     if scores is None:
         scores_t = None
     else:
@@ -101,6 +105,10 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         oh = ow = output_size
     else:
         oh, ow = output_size
+    # sampling_ratio=-1: the reference adapts per-RoI (ceil(roi/output));
+    # that is data-dependent shape, so this TPU build uses a static 2x2
+    # grid per cell instead — a deliberate static-shape tradeoff that
+    # deviates numerically from adaptive sampling for large RoIs
     ratio = sampling_ratio if sampling_ratio > 0 else 2
 
     def fn(feat, bx, bnum):
@@ -169,12 +177,14 @@ def box_coder(prior_box, prior_box_var, target_box,
         return cx, cy, w, h
 
     def fn(pb, tb, *maybe_var):
-        var = (maybe_var[0] if maybe_var
-               else jnp.asarray(prior_box_var
-                                if isinstance(prior_box_var,
-                                              (list, tuple))
-                                else [1.0, 1.0, 1.0, 1.0],
-                                jnp.float32))
+        if maybe_var:
+            var = maybe_var[0]
+        elif isinstance(prior_box_var, (list, tuple)):
+            var = jnp.asarray(prior_box_var, jnp.float32)
+        elif isinstance(prior_box_var, (int, float)):
+            var = jnp.full((4,), float(prior_box_var), jnp.float32)
+        else:
+            var = jnp.ones((4,), jnp.float32)
         pcx, pcy, pw, ph = centers(pb)
         if code_type == "encode_center_size":
             tcx, tcy, tw, th = centers(tb)

@@ -1,0 +1,53 @@
+"""paddle.audio features vs manual DSP oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.audio import Spectrogram, MelSpectrogram, MFCC
+
+
+def test_windows():
+    w = np.asarray(AF.get_window("hann", 64)._value)
+    np.testing.assert_allclose(
+        w, 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(64) / 64), rtol=1e-5)
+    assert np.asarray(AF.get_window("hamming", 32)._value).shape == (32,)
+
+
+def test_mel_scale_roundtrip():
+    for htk in (False, True):
+        hz = 440.0
+        back = AF.mel_to_hz(AF.hz_to_mel(hz, htk), htk)
+        np.testing.assert_allclose(back, hz, rtol=1e-4)
+
+
+def test_fbank_shape_and_coverage():
+    fb = np.asarray(AF.compute_fbank_matrix(16000, 512, n_mels=40)._value)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all() and fb.sum() > 0
+
+
+def test_spectrogram_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2048).astype("f4")
+    layer = Spectrogram(n_fft=256, hop_length=128, center=False)
+    out = np.asarray(layer(paddle.to_tensor(x))._value)
+    w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(256) / 256)
+    ref0 = np.abs(np.fft.rfft(x[0, :256] * w)) ** 2
+    np.testing.assert_allclose(out[0, :, 0], ref0, rtol=1e-2, atol=1e-2)
+
+
+def test_mel_and_mfcc_shapes():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 4096).astype("f4"))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert mel.shape[0:2] == [2, 40]
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert mfcc.shape[0:2] == [2, 13]
+    assert np.isfinite(np.asarray(mfcc._value)).all()
+
+
+def test_power_to_db():
+    x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], "f4"))
+    db = np.asarray(AF.power_to_db(x, top_db=None)._value)
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)

@@ -110,3 +110,27 @@ def test_vjp_multi_input_returns_tuple():
     out, grads = paddle.autograd.vjp(lambda a, b: (a * b).sum(), [x, y])
     assert isinstance(grads, tuple) and len(grads) == 2
     np.testing.assert_allclose(float(grads[0]), 2.0, rtol=1e-6)
+
+
+def test_nms_empty_boxes():
+    keep = paddle.vision.ops.nms(_t(np.zeros((0, 4), "f4")), 0.5)
+    assert keep.shape == [0]
+
+
+def test_box_coder_scalar_variance():
+    priors = np.array([[0, 0, 10, 10]], "f4")
+    targets = np.array([[1, 1, 9, 9]], "f4")
+    enc_half = paddle.vision.ops.box_coder(_t(priors), 0.5, _t(targets))
+    enc_one = paddle.vision.ops.box_coder(
+        _t(priors), [1.0, 1.0, 1.0, 1.0], _t(targets))
+    np.testing.assert_allclose(
+        np.asarray(enc_half._value), 2 * np.asarray(enc_one._value),
+        rtol=1e-5)
+
+
+def test_vjp_outputs_stay_on_tape():
+    x = _t(np.array([1.0, 2.0], "f4"))
+    x.stop_gradient = False
+    out, g = paddle.autograd.vjp(lambda t: (t ** 3).sum(), x)
+    (gg,) = paddle.grad(g.sum(), [x])  # d/dx sum(3x^2) = 6x
+    np.testing.assert_allclose(np.asarray(gg._value), [6.0, 12.0], rtol=1e-5)
