@@ -2,6 +2,7 @@
 — unverified, SURVEY.md §0)."""
 from __future__ import annotations
 
+import math
 import jax
 import jax.numpy as jnp
 
@@ -273,8 +274,126 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
+    """CTC loss (reference paddle.nn.functional.ctc_loss; layout
+    log_probs (T, B, C) like the reference). The log-semiring
+    forward recursion runs as optax.ctc_loss's lax.scan — TPU-friendly
+    static shapes with per-sequence length masking."""
+    import optax
+
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def fn(lp, lab, in_len, lab_len):
+        # optax: logits (B, T, C), paddings 1.0 at padded steps
+        logits = jnp.swapaxes(lp, 0, 1)
+        bsz, t = logits.shape[0], logits.shape[1]
+        logit_pad = (jnp.arange(t)[None, :]
+                     >= in_len[:, None]).astype(jnp.float32)
+        lab_pad = (jnp.arange(lab.shape[1])[None, :]
+                   >= lab_len[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(
+            logits, logit_pad, lab.astype(jnp.int32), lab_pad,
+            blank_id=blank,
+        )
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(in_len.astype(jnp.float32), 1)
+        if reduction == "mean":
+            # paddle semantics: each sequence's loss is divided by its
+            # label length before averaging
+            per_seq = per_seq / jnp.maximum(
+                lab_len.astype(jnp.float32), 1)
+        return _reduce_loss(per_seq, reduction)
+
+    return apply(fn, log_probs, labels, input_lengths, label_lengths,
+                 op_name="ctc_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        return _reduce_loss(jax.nn.softplus(-y * x), reduction)
+
+    return apply(fn, ensure_tensor(input), ensure_tensor(label),
+                 op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+
+    def fn(x, y, *maybe_w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce_loss(loss.mean(-1), reduction)
+
+    return apply(fn, *args, op_name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+
+    def fn(x, y, *maybe_w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        diff = jnp.maximum(margin - correct + x, 0.0) ** p
+        if maybe_w:
+            diff = diff * maybe_w[0][y.astype(jnp.int32)][:, None]
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c)
+        per = (diff * (1 - mask)).sum(-1) / c
+        return _reduce_loss(per, reduction)
+
+    return apply(fn, *args, op_name="multi_margin_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce_loss(loss, reduction)
+
+    return apply(fn, ensure_tensor(input), ensure_tensor(label),
+                 ensure_tensor(variance), op_name="gaussian_nll_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(jnp.maximum(x, epsilon))
+        if full:
+            stirling = (y * jnp.log(jnp.maximum(y, 1.0))
+                        - y + 0.5 * jnp.log(
+                            2 * math.pi * jnp.maximum(y, 1.0)))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply(fn, ensure_tensor(input), ensure_tensor(label),
+                 op_name="poisson_nll_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Intentionally unimplemented (raises): hierarchical softmax is
+    PS-era sparse-training machinery with no TPU win — use
+    cross_entropy (full softmax beats tree traversal on the MXU)."""
     raise NotImplementedError(
-        "ctc_loss lands with the speech model family; out of round-1 scope"
+        "hsigmoid_loss: custom-tree hierarchical softmax is PS-era "
+        "sparse-training machinery; use cross_entropy (full softmax on "
+        "TPU is faster than tree traversal at these vocab sizes)"
     )
 
 
@@ -284,4 +403,6 @@ __all__ = [
     "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
     "cosine_embedding_loss", "hinge_embedding_loss", "sigmoid_focal_loss",
     "square_error_cost", "triplet_margin_loss", "ctc_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "multi_margin_loss",
+    "gaussian_nll_loss", "poisson_nll_loss", "hsigmoid_loss",
 ]

@@ -12,7 +12,7 @@ from ...core.tensor import Parameter, Tensor
 
 __all__ = [
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
-    "Flatten", "Identity", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D", "Upsample",
+    "Flatten", "Unflatten", "Identity", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D", "Upsample",
     "UpsamplingNearest2D", "UpsamplingBilinear2D", "PixelShuffle",
     "PixelUnshuffle", "ChannelShuffle", "CosineSimilarity", "Bilinear",
     "Unfold", "Fold",
@@ -125,6 +125,17 @@ class Flatten(Layer):
         from ...tensor.manipulation import flatten
 
         return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...tensor.extras import unflatten
+
+        return unflatten(x, self.axis, self.shape)
 
 
 class Identity(Layer):

@@ -9,7 +9,9 @@ __all__ = [
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
     "CosineEmbeddingLoss", "HingeEmbeddingLoss", "TripletMarginLoss",
-    "SigmoidFocalLoss",
+    "SigmoidFocalLoss", "CTCLoss", "SoftMarginLoss",
+    "MultiLabelSoftMarginLoss", "MultiMarginLoss", "GaussianNLLLoss",
+    "PoissonNLLLoss", "PairwiseDistance",
 ]
 
 
@@ -140,3 +142,91 @@ class SigmoidFocalLoss(Layer):
 
     def forward(self, logit, label, normalizer=None):
         return F.sigmoid_focal_loss(logit, label, normalizer, **self._args)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, self.weight, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(
+            input, label, self.p, self.margin, self.weight, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(
+            input, label, variance, self.full, self.epsilon, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(
+            input, label, self.log_input, self.full, self.epsilon,
+            self.reduction)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+        from ...tensor._helpers import apply, ensure_tensor
+
+        def fn(a, b):
+            d = jnp.abs(a - b) + self.epsilon
+            if self.p == float("inf"):
+                out = d.max(-1)
+            elif self.p == 0:
+                out = (d != 0).sum(-1).astype(a.dtype)
+            else:
+                out = (d ** self.p).sum(-1) ** (1.0 / self.p)
+            return out[..., None] if self.keepdim else out
+
+        return apply(fn, ensure_tensor(x), ensure_tensor(y),
+                     op_name="pairwise_distance")

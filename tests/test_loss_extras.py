@@ -1,0 +1,121 @@
+"""New loss layers (CTC, soft-margin family, Gaussian/Poisson NLL,
+PairwiseDistance, Unflatten) vs scipy/torch-formula oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_ctc_loss_matches_simple_case():
+    # T=4, B=1, C=3 (blank=0); target [1,2]
+    rng = np.random.RandomState(0)
+    logits = rng.randn(4, 1, 3).astype("f4")
+    log_probs = paddle.nn.functional.log_softmax(_t(logits), axis=-1)
+    labels = _t(np.array([[1, 2]], "i4"))
+    loss = nn.CTCLoss(blank=0, reduction="sum")(
+        log_probs, labels, _t(np.array([4], "i4")), _t(np.array([2], "i4")))
+    # brute-force: sum over all valid alignments
+    lp = np.asarray(log_probs._value)[:, 0, :]
+    import itertools
+
+    total = -np.inf
+    for path in itertools.product(range(3), repeat=4):
+        # collapse
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != 0:
+                out.append(s)
+            prev = s
+        if out == [1, 2]:
+            ll = sum(lp[t, path[t]] for t in range(4))
+            total = np.logaddexp(total, ll)
+    np.testing.assert_allclose(float(loss), -total, rtol=1e-4)
+
+
+def test_ctc_loss_trains():
+    paddle.seed(0)
+    rng = np.random.RandomState(1)
+    logits = paddle.to_tensor(rng.randn(8, 2, 5).astype("f4"))
+    logits.stop_gradient = False
+    labels = _t(np.array([[1, 2, 3], [2, 2, 0]], "i4"))
+    loss = F.ctc_loss(
+        paddle.nn.functional.log_softmax(logits, axis=-1), labels,
+        _t(np.array([8, 6], "i4")), _t(np.array([3, 2], "i4")))
+    loss.backward()
+    assert logits.grad is not None
+    assert np.isfinite(np.asarray(logits.grad._value)).all()
+
+
+def test_soft_margin_family():
+    x = _t(np.array([[0.5, -1.0]], "f4"))
+    y = _t(np.array([[1.0, -1.0]], "f4"))
+    loss = F.soft_margin_loss(x, y)
+    expect = np.log1p(np.exp(-np.array([0.5, 1.0]))).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+    lab = _t(np.array([[1.0, 0.0]], "f4"))
+    ml = F.multi_label_soft_margin_loss(x, lab)
+    assert np.isfinite(float(ml))
+
+    scores = _t(np.array([[0.1, 0.9, 0.2]], "f4"))
+    mm = F.multi_margin_loss(scores, _t(np.array([1], "i8")))
+    expect = (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.2)) / 3
+    np.testing.assert_allclose(float(mm), expect, rtol=1e-5)
+
+
+def test_nll_losses():
+    mu = _t(np.array([1.0, 2.0], "f4"))
+    y = _t(np.array([1.5, 1.0], "f4"))
+    var = _t(np.array([1.0, 4.0], "f4"))
+    g = F.gaussian_nll_loss(mu, y, var)
+    expect = 0.5 * (np.log([1.0, 4.0])
+                    + np.array([0.25, 1.0]) / np.array([1.0, 4.0]))
+    np.testing.assert_allclose(float(g), expect.mean(), rtol=1e-5)
+
+    lx = _t(np.array([0.0, 1.0], "f4"))
+    p = F.poisson_nll_loss(lx, _t(np.array([1.0, 2.0], "f4")))
+    expect = (np.exp([0.0, 1.0]) - np.array([1.0, 2.0]) * [0.0, 1.0]).mean()
+    np.testing.assert_allclose(float(p), expect, rtol=1e-5)
+
+
+def test_pairwise_distance_and_unflatten():
+    a = _t(np.array([[0.0, 0.0], [1.0, 1.0]], "f4"))
+    b = _t(np.array([[3.0, 4.0], [1.0, 1.0]], "f4"))
+    d = nn.PairwiseDistance()(a, b)
+    np.testing.assert_allclose(
+        np.asarray(d._value), [5.0, 0.0], rtol=1e-3, atol=2e-3)
+    u = nn.Unflatten(1, [2, 3])(_t(np.zeros((4, 6), "f4")))
+    assert u.shape == [4, 2, 3]
+
+
+def test_ctc_mean_divides_by_label_lengths():
+    rng = np.random.RandomState(2)
+    logits = rng.randn(6, 2, 4).astype("f4")
+    lp = paddle.nn.functional.log_softmax(_t(logits), axis=-1)
+    labels = _t(np.array([[1, 2, 3], [2, 1, 0]], "i4"))
+    in_len = _t(np.array([6, 6], "i4"))
+    lab_len = _t(np.array([3, 2], "i4"))
+    mean = F.ctc_loss(lp, labels, in_len, lab_len, reduction="mean")
+    per = np.asarray(
+        F.ctc_loss(lp, labels, in_len, lab_len, reduction="none")._value)
+    np.testing.assert_allclose(
+        float(mean), (per / np.array([3.0, 2.0])).mean(), rtol=1e-5)
+
+
+def test_soft_margin_loss_stable_at_extreme_logits():
+    loss = F.soft_margin_loss(
+        _t(np.array([-100.0], "f4")), _t(np.array([1.0], "f4")))
+    np.testing.assert_allclose(float(loss), 100.0, rtol=1e-4)
+
+
+def test_pairwise_distance_inf_norm():
+    d = nn.PairwiseDistance(p=float("inf"))(
+        _t(np.array([[0.0, 0.0]], "f4")), _t(np.array([[3.0, 4.0]], "f4")))
+    np.testing.assert_allclose(np.asarray(d._value), [4.0], rtol=1e-4)
