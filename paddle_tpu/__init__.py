@@ -113,3 +113,4 @@ from . import distribution  # noqa: E402
 from . import signal  # noqa: E402
 from . import geometric  # noqa: E402
 from . import audio  # noqa: E402
+from . import text  # noqa: E402

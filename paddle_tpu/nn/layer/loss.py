@@ -149,9 +149,11 @@ class CTCLoss(Layer):
         super().__init__()
         self.blank, self.reduction = blank, reduction
 
-    def forward(self, log_probs, labels, input_lengths, label_lengths):
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
-                          blank=self.blank, reduction=self.reduction)
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
 
 
 class SoftMarginLoss(Layer):
@@ -215,18 +217,8 @@ class PairwiseDistance(Layer):
         self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
 
     def forward(self, x, y):
-        import jax.numpy as jnp
-        from ...tensor._helpers import apply, ensure_tensor
+        from ...tensor.linalg import norm
 
-        def fn(a, b):
-            d = jnp.abs(a - b) + self.epsilon
-            if self.p == float("inf"):
-                out = d.max(-1)
-            elif self.p == 0:
-                out = (d != 0).sum(-1).astype(a.dtype)
-            else:
-                out = (d ** self.p).sum(-1) ** (1.0 / self.p)
-            return out[..., None] if self.keepdim else out
-
-        return apply(fn, ensure_tensor(x), ensure_tensor(y),
-                     op_name="pairwise_distance")
+        # one p-norm implementation lives in linalg.norm
+        return norm(x - y + self.epsilon, p=self.p, axis=-1,
+                    keepdim=self.keepdim)

@@ -1,0 +1,92 @@
+"""paddle.text — sequence decoding utilities (reference:
+python/paddle/text/viterbi_decode.py — unverified, SURVEY.md §0).
+
+``viterbi_decode`` runs the max-product recursion as a ``lax.scan``
+(TPU-friendly static shapes; lengths masked) and recovers paths by a
+reverse scan over the argmax backpointers. Datasets from the reference's
+paddle.text.datasets require downloads (zero-egress here) and are not
+provided."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .nn.layer.layers import Layer
+from .tensor._helpers import apply, ensure_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding.
+
+    potentials (B, T, N) emission scores, transition_params (N, N) with
+    transition[i, j] = score of i → j, lengths (B,). With
+    ``include_bos_eos_tag`` the last two tags are treated as BOS/EOS
+    (reference semantics). Returns (scores (B,), paths (B, T) int32)."""
+    potentials = ensure_tensor(potentials)
+    transition_params = ensure_tensor(transition_params)
+    lengths = ensure_tensor(lengths)
+
+    def fn(emit, trans, lens):
+        b, t, n = emit.shape
+        if include_bos_eos_tag:
+            bos, eos = n - 2, n - 1
+            init = emit[:, 0] + trans[bos][None, :]
+        else:
+            init = emit[:, 0]
+
+        def step(carry, xs):
+            alpha = carry  # (B, N) best score ending at each tag
+            e_t, idx = xs
+            # (B, N_prev, N_next)
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)  # (B, N)
+            alpha_new = jnp.max(scores, axis=1) + e_t
+            # sequences already past their length keep their alpha
+            active = (idx < lens)[:, None]
+            alpha_out = jnp.where(active, alpha_new, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.arange(n)[None, :])
+            return alpha_out, bp
+
+        xs = (jnp.swapaxes(emit[:, 1:], 0, 1), jnp.arange(1, t))
+        alpha, bps = jax.lax.scan(step, init, xs)  # bps (T-1, B, N)
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        best_last = jnp.argmax(alpha, axis=-1)  # (B,)
+        best_score = jnp.max(alpha, axis=-1)
+
+        def back(carry, bp_idx):
+            tag = carry  # (B,)
+            bp, idx = bp_idx  # (B, N), scalar
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            # positions beyond length-1 keep the final tag
+            prev = jnp.where(idx < lens, prev, tag)
+            return prev, tag
+
+        first, path_rev = jax.lax.scan(
+            back, best_last, (bps[::-1], jnp.arange(t - 1, 0, -1)),
+        )
+        # final carry is the step-0 tag; path_rev holds steps t-1 .. 1
+        paths = jnp.concatenate(
+            [first[:, None], path_rev[::-1].T], axis=1
+        )  # (B, T)
+        return best_score, paths.astype(jnp.int32)
+
+    return apply(fn, potentials, transition_params, lengths,
+                 op_name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths,
+            self.include_bos_eos_tag,
+        )

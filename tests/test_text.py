@@ -1,0 +1,60 @@
+"""paddle.text ViterbiDecoder vs a brute-force path-search oracle."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import viterbi_decode, ViterbiDecoder
+
+
+def _brute(emit, trans, length, bos_eos):
+    n = emit.shape[1]
+    tags = range(n - 2) if bos_eos else range(n)
+    best, best_path = -np.inf, None
+    for path in itertools.product(tags, repeat=length):
+        s = emit[0, path[0]]
+        if bos_eos:
+            s += trans[n - 2, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+        if bos_eos:
+            s += trans[path[-1], n - 1]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.RandomState(0)
+    b, t, n = 2, 5, 5
+    emit = rng.randn(b, t, n).astype("f4")
+    if bos_eos:
+        # BOS/EOS tags can't be emitted mid-sequence
+        emit[:, :, -2:] = -1e4
+    trans = rng.randn(n, n).astype("f4")
+    lens = np.array([t, t], "i8")
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+    for i in range(b):
+        ref_s, ref_p = _brute(emit[i], trans, t, bos_eos)
+        np.testing.assert_allclose(float(scores[i]), ref_s, rtol=1e-4)
+        np.testing.assert_array_equal(np.asarray(paths._value)[i], ref_p)
+
+
+def test_viterbi_decoder_layer_and_lengths():
+    rng = np.random.RandomState(1)
+    emit = rng.randn(2, 6, 4).astype("f4")
+    trans = rng.randn(4, 4).astype("f4")
+    dec = ViterbiDecoder(paddle.to_tensor(trans),
+                         include_bos_eos_tag=False)
+    scores, paths = dec(
+        paddle.to_tensor(emit),
+        paddle.to_tensor(np.array([6, 3], "i8")))
+    # the shorter sequence's score must match brute force on its prefix
+    ref_s, ref_p = _brute(emit[1][:3], trans, 3, False)
+    np.testing.assert_allclose(float(scores[1]), ref_s, rtol=1e-4)
+    np.testing.assert_array_equal(
+        np.asarray(paths._value)[1][:3], ref_p)
