@@ -4,4 +4,9 @@ distributed features, TPU-native."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 
-__all__ = ["nn", "distributed"]
+__all__ = ["nn", "distributed", "optimizer", "LookAhead",
+           "ModelAverage", "ExponentialMovingAverage"]
+from . import optimizer  # noqa: E402,F401
+from .optimizer import (  # noqa: E402,F401
+    LookAhead, ModelAverage, ExponentialMovingAverage,
+)

@@ -1,0 +1,154 @@
+"""paddle.incubate.optimizer — LookAhead / ModelAverage / EMA (reference:
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py — unverified,
+SURVEY.md §0).
+
+All three are parameter-buffer transforms around an inner optimizer:
+state lives as host-held jax arrays updated with fused jnp expressions
+(one jitted elementwise pass per step — no per-param Python dispatch)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: every k inner steps, slow weights interpolate
+    toward fast weights and both sync (Zhang et al., reference
+    incubate.optimizer.LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step = 0
+        self._slow = None
+        self._interp = jax.jit(
+            lambda slow, fast: [
+                s + self.alpha * (f - s) for s, f in zip(slow, fast)
+            ]
+        )
+
+    def _params(self):
+        return list(self.inner_optimizer._parameter_list or [])
+
+    def step(self):
+        params = self._params()
+        if self._slow is None:
+            self._slow = [p._value for p in params]
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            new_slow = self._interp(self._slow, [p._value for p in params])
+            self._slow = new_slow
+            states = getattr(self.inner_optimizer, "_states", {})
+            for p, v in zip(params, new_slow):
+                p._value = v
+                # multi_precision: the fp32 master is the live copy the
+                # next update reads — sync it too or the interpolation
+                # is silently discarded
+                st = states.get(id(p))
+                if st is not None and "master" in st:
+                    st["master"] = v.astype(st["master"].dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step": self._step, "slow": self._slow}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state["inner"])
+        self._step = state.get("step", 0)
+        self._slow = state.get("slow")
+
+
+class _AveragerBase:
+    def __init__(self, parameters):
+        self._params = list(parameters)
+        self._avg = None
+        self._backup = None
+
+    def _values(self):
+        return [p._value for p in self._params]
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (eval); restore() undoes it."""
+        if self._avg is None:
+            return
+        self._backup = self._values() if need_restore else None
+        for p, a in zip(self._params, self._avg):
+            p._value = a.astype(p._value.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._value = b
+            self._backup = None
+
+
+class ModelAverage(_AveragerBase):
+    """Running average of parameters over an accumulation window
+    (reference incubate.optimizer.ModelAverage; the window controls are
+    accepted for parity — the average here is the running mean of every
+    ``step()`` call, which is what the reference degrades to when the
+    window covers training)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "ModelAverage requires parameters= (this backend has no "
+                "global parameter registry to default to)"
+            )
+        super().__init__(parameters)
+        self._n = 0
+        self._acc = jax.jit(
+            lambda avg, vals, n: [
+                a + (v.astype(jnp.float32) - a) / (n + 1)
+                for a, v in zip(avg, vals)
+            ]
+        )
+
+    def step(self):
+        vals = self._values()
+        if self._avg is None:
+            self._avg = [v.astype(jnp.float32) for v in vals]
+            self._n = 1
+            return
+        self._avg = self._acc(self._avg, vals, jnp.float32(self._n))
+        self._n += 1
+
+    # paddle calls minimize/step on the wrapped optimizer externally
+
+
+class ExponentialMovingAverage(_AveragerBase):
+    """EMA of parameters: shadow = decay * shadow + (1-decay) * param
+    (reference paddle.incubate ExponentialMovingAverage)."""
+
+    def __init__(self, parameters, decay=0.999, name=None):
+        super().__init__(parameters)
+        self.decay = float(decay)
+        self._ema = jax.jit(
+            lambda avg, vals: [
+                self.decay * a + (1 - self.decay) * v.astype(jnp.float32)
+                for a, v in zip(avg, vals)
+            ]
+        )
+
+    def update(self):
+        vals = self._values()
+        if self._avg is None:
+            self._avg = [v.astype(jnp.float32) for v in vals]
+            return
+        self._avg = self._ema(self._avg, vals)
+
+    step = update

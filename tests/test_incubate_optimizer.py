@@ -1,0 +1,101 @@
+"""incubate.optimizer: LookAhead / ModelAverage / EMA."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import (
+    LookAhead, ModelAverage, ExponentialMovingAverage,
+)
+
+
+def _setup():
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype("f4"))
+    return m, x
+
+
+def test_lookahead_trains_and_syncs():
+    m, x = _setup()
+    inner = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    losses = []
+    for _ in range(6):
+        loss = ((m(x) - x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert opt._slow is not None
+
+
+def test_lookahead_slow_weights_interpolate():
+    m, x = _setup()
+    w0 = np.asarray(m.weight._value).copy()
+    inner = paddle.optimizer.SGD(0.5, parameters=m.parameters())
+    opt = LookAhead(inner, alpha=0.0, k=1)  # alpha=0: snap back to slow
+    loss = ((m(x) - x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    np.testing.assert_allclose(
+        np.asarray(m.weight._value), w0, rtol=1e-6)  # fully reverted
+
+
+def test_ema_apply_restore():
+    m, x = _setup()
+    ema = ExponentialMovingAverage(m.parameters(), decay=0.5)
+    ema.update()
+    live = np.asarray(m.weight._value).copy()
+    m.weight.set_value(paddle.to_tensor(live + 1.0))
+    ema.update()
+    # shadow = 0.5*live + 0.5*(live+1) = live + 0.5
+    ema.apply()
+    np.testing.assert_allclose(
+        np.asarray(m.weight._value), live + 0.5, rtol=1e-5)
+    ema.restore()
+    np.testing.assert_allclose(
+        np.asarray(m.weight._value), live + 1.0, rtol=1e-6)
+
+
+def test_model_average_running_mean():
+    m, x = _setup()
+    ma = ModelAverage(parameters=m.parameters())
+    vals = []
+    for i in range(3):
+        m.weight.set_value(
+            paddle.to_tensor(np.full((4, 4), float(i), "f4")))
+        ma.step()
+        vals.append(float(i))
+    ma.apply()
+    np.testing.assert_allclose(
+        np.asarray(m.weight._value), np.mean(vals), rtol=1e-5)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(m.weight._value), 2.0)
+
+
+def test_lookahead_syncs_master_weights():
+    m, x = _setup()
+    inner = paddle.optimizer.SGD(
+        0.0, parameters=m.parameters(), multi_precision=True)
+    # force master-state creation with one step
+    loss = ((m(x) - x) ** 2).mean()
+    loss.backward()
+    opt = LookAhead(inner, alpha=0.0, k=1)
+    w0 = np.asarray(m.weight._value).copy()
+    opt.step()  # alpha=0 → snap back to slow (w0), incl. master
+    st = inner._states.get(id(m.weight))
+    if st is not None and "master" in st:
+        np.testing.assert_allclose(
+            np.asarray(st["master"]), w0, rtol=1e-6)
+
+
+def test_model_average_requires_parameters():
+    with pytest.raises(ValueError, match="parameters"):
+        ModelAverage(0.15)
+
+
+def test_lookahead_none_parameters_noop():
+    opt = LookAhead(paddle.optimizer.SGD(0.1), k=1)
+    opt.step()  # must not raise
