@@ -1,7 +1,8 @@
 """paddle.optimizer namespace."""
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
-    RMSProp, Lamb, LarsMomentum,
+    RMSProp, Lamb, LarsMomentum, Rprop, NAdam, RAdam, ASGD,
+    LBFGS,
 )
 from . import lr  # noqa: F401
 from .clip import (  # noqa: F401

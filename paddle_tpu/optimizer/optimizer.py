@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor, Parameter
 from ..core import autograd
@@ -21,7 +22,8 @@ from .clip import ClipGradBase
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-    "Adadelta", "RMSProp", "Lamb", "LarsMomentum",
+    "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "Rprop", "NAdam",
+    "RAdam", "ASGD", "LBFGS",
 ]
 
 
@@ -566,3 +568,230 @@ class LarsMomentum(Optimizer):
             g32 + self._lars_wd * p32
         )
         return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference paddle.optimizer.Rprop): per-weight
+    step sizes grown/shrunk by the sign agreement of successive grads."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+
+    def _init_state(self, p_value):
+        return {
+            "prev_grad": jnp.zeros(p_value.shape, jnp.float32),
+            "step_size": jnp.full(p_value.shape, self.get_lr(),
+                                  jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * state["prev_grad"])
+        grow = jnp.where(sign > 0, self._eta_plus,
+                         jnp.where(sign < 0, self._eta_minus, 1.0))
+        step_size = jnp.clip(state["step_size"] * grow,
+                             self._lr_min, self._lr_max)
+        # on sign flip: revert grad (classic Rprop-): no step this round
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        new_p = p.astype(jnp.float32) - jnp.sign(g_eff) * step_size
+        return new_p.astype(p.dtype), {
+            "prev_grad": g_eff, "step_size": step_size,
+        }
+
+
+class NAdam(Adam):
+    """Adam with Nesterov momentum and the reference's mu_t momentum-decay
+    schedule (paddle.optimizer.NAdam, momentum_decay=0.004)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, **kwargs)
+        self._psi = float(momentum_decay)
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        t = step.astype(jnp.float32)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        # running products of mu (closed form since mu depends on t only)
+        # approximate prod via stored scalar is avoided: use the paddle
+        # recurrences with mu products tracked in state
+        mu_prod = state.get(
+            "mu_prod", jnp.ones((), jnp.float32)) * mu_t
+        m = self._beta1 * state["moment1"].astype(jnp.float32) \
+            + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"].astype(jnp.float32) \
+            + (1 - self._beta2) * jnp.square(g32)
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g32 / (1 - mu_prod))
+        v_hat = v / (1 - self._beta2 ** t)
+        new_p = p.astype(jnp.float32) - lr * m_hat / (
+            jnp.sqrt(v_hat) + self._eps)
+        md = self._moment_dtype
+        return new_p.astype(p.dtype), {
+            "moment1": m.astype(md), "moment2": v.astype(md),
+            "mu_prod": mu_prod,
+        }
+
+    def _init_state(self, p_value):
+        st = super()._init_state(p_value)
+        st["mu_prod"] = jnp.ones((), jnp.float32)
+        return st
+
+
+class RAdam(Adam):
+    """Rectified Adam (reference paddle.optimizer.RAdam): warms up the
+    adaptive term by the variance-rectification factor."""
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        g32 = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * g32
+        v = b2 * state["moment2"].astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2 ** t / (1 - b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        v_hat = jnp.sqrt(v / (1 - b2 ** t))
+        adaptive = lr * r * m_hat / (v_hat + self._eps)
+        plain = lr * m_hat
+        new_p = p.astype(jnp.float32) - jnp.where(rho_t > 4.0, adaptive,
+                                                  plain)
+        md = self._moment_dtype
+        return new_p.astype(p.dtype), {
+            "moment1": m.astype(md), "moment2": v.astype(md),
+        }
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference paddle.optimizer.ASGD): SGD steps plus a
+    running parameter average stored alongside the state."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._batch_num = max(int(batch_num), 1)
+
+    def _init_state(self, p_value):
+        return {
+            "d": jnp.zeros(p_value.shape, jnp.float32),  # rolling grad sum
+            "y": jnp.zeros(p_value.shape, jnp.float32),  # grad replaced
+        }
+
+    def _update(self, p, g, state, lr, step, decay=True):
+        # reference recurrence: d <- d - y + g; y <- g; p -= lr * d / n
+        g32 = g.astype(jnp.float32)
+        d = state["d"] - state["y"] + g32
+        n = jnp.minimum(step.astype(jnp.float32), float(self._batch_num))
+        new_p = p.astype(jnp.float32) - lr * d / jnp.maximum(n, 1.0)
+        return new_p.astype(p.dtype), {"d": d, "y": g32}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with closure-based step (reference paddle.optimizer.LBFGS).
+
+    ``step(closure)`` re-evaluates loss+grads; the two-loop recursion
+    over the last ``history_size`` (s, y) pairs runs as fused jnp ops on
+    flattened parameters."""
+
+    def __init__(self, learning_rate=1.0, max_iter=1, history_size=10,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 parameters=None, line_search_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, None, False, name)
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self._hist = []  # list of (s, y, rho) flattened
+        self._prev = None  # (flat_params, flat_grad)
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.astype(jnp.float32).reshape(-1)
+                                for v in vals])
+
+    def _unflat(self, flat):
+        out, off = [], 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._value.shape)) if p._value.ndim else 1
+            out.append(flat[off: off + n].reshape(p._value.shape))
+            off += n
+        return out
+
+    def _direction(self, q):
+        alphas = []
+        for s, y, rho in reversed(self._hist):
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append(a)
+        if self._hist:
+            s, y, _ = self._hist[-1]
+            q = q * (jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-12))
+        for (s, y, rho), a in zip(self._hist, reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        loss = None
+        for _ in range(self.max_iter):
+            loss = closure()
+            params = [p for p in (self._parameter_list or [])
+                      if p.grad is not None]
+            if not params:
+                return loss
+            flat_g = self._flat([p.grad._value for p in params])
+            flat_p = self._flat([p._value for p in params])
+            if float(jnp.max(jnp.abs(flat_g))) <= self.tol_grad:
+                break
+            if self._prev is not None:
+                # curvature pair from the PREVIOUS accepted step
+                s = flat_p - self._prev[0]
+                y = flat_g - self._prev[1]
+                sy = float(jnp.dot(s, y))
+                if sy > 1e-10:
+                    self._hist.append((s, y, 1.0 / sy))
+                    if len(self._hist) > self.history_size:
+                        self._hist.pop(0)
+            # record the CURRENT point before stepping away from it
+            self._prev = (flat_p, flat_g)
+            d = -self._direction(flat_g)
+            lr = self.get_lr()
+            step_vec = lr * d
+            if float(jnp.max(jnp.abs(step_vec))) <= self.tol_change:
+                break
+            new_flat = flat_p + step_vec
+            for p, v in zip(params, self._unflat(new_flat)):
+                p._value = v.astype(p._value.dtype)
+        return loss
+
+    def state_dict(self):
+        out = super().state_dict()
+        out["lbfgs_hist"] = [
+            (np.asarray(s), np.asarray(y), r) for s, y, r in self._hist
+        ]
+        if self._prev is not None:
+            out["lbfgs_prev"] = tuple(np.asarray(v) for v in self._prev)
+        return out
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        self._hist = [
+            (jnp.asarray(s), jnp.asarray(y), r)
+            for s, y, r in state.get("lbfgs_hist", [])
+        ]
+        prev = state.get("lbfgs_prev")
+        self._prev = tuple(jnp.asarray(v) for v in prev) if prev else None

@@ -189,3 +189,93 @@ def test_adam_bf16_moments_close_to_f32():
     # loss curves agree to bf16 tolerance and both decrease
     assert l16[-1] < l16[0] and l32[-1] < l32[0]
     np.testing.assert_allclose(l16, l32, rtol=0.05, atol=1e-3)
+
+
+@pytest.mark.parametrize("cls_name", ["Rprop", "NAdam", "RAdam", "ASGD"])
+def test_new_optimizers_converge(cls_name):
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    cls = getattr(paddle.optimizer, cls_name)
+    opt = cls(0.01, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 4).astype("f4"))
+    losses = []
+    for _ in range(30):
+        loss = ((m(x) - x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses[:3] + losses[-3:]
+
+
+def test_lbfgs_quadratic_converges_fast():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    # strongly convex quadratic: LBFGS should crush it in a few closures
+    target = np.random.RandomState(1).randn(6).astype("f4")
+    w = paddle.to_tensor(np.zeros(6, "f4"))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(
+        learning_rate=0.5, max_iter=4, parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        loss = opt.step(closure)
+    np.testing.assert_allclose(
+        np.asarray(w._value), target, rtol=1e-2, atol=1e-2)
+
+
+def test_lbfgs_builds_curvature_history():
+    """Regression: the (s, y) pairs must actually accumulate (a
+    bookkeeping bug once made LBFGS silently degrade to plain GD)."""
+    rng = np.random.RandomState(2)
+    A = rng.randn(8, 8).astype("f4")
+    A = A @ A.T + 8 * np.eye(8, dtype="f4")  # SPD, conditioned
+    b = rng.randn(8).astype("f4")
+    w = paddle.to_tensor(np.zeros(8, "f4"))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(
+        learning_rate=0.05, max_iter=3, parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        Aw = paddle.to_tensor(A) @ w
+        loss = 0.5 * (w * Aw).sum() - (paddle.to_tensor(b) * w).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(4):
+        opt.step(closure)
+    assert len(opt._hist) > 0  # curvature pairs recorded
+    expect = np.linalg.solve(A, b)
+    np.testing.assert_allclose(
+        np.asarray(w._value), expect, rtol=0.05, atol=0.05)
+    # state roundtrip keeps history
+    st = opt.state_dict()
+    opt2 = paddle.optimizer.LBFGS(parameters=[w])
+    opt2.set_state_dict(st)
+    assert len(opt2._hist) == len(opt._hist)
+
+
+def test_asgd_averages_gradients():
+    # constant grad g: after warmup d/n == g, so same as SGD; alternating
+    # grads must average out
+    w = paddle.to_tensor(np.zeros(1, "f4"))
+    w.stop_gradient = False
+    opt = paddle.optimizer.ASGD(0.1, batch_num=2, parameters=[w])
+    for i in range(4):
+        sign = 1.0 if i % 2 == 0 else -1.0
+        loss = (w * sign).sum()  # d/dw = sign
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # alternating +-1 grads with window 2 → net movement ~ first step only
+    assert abs(float(w._value[0])) < 0.2
