@@ -319,4 +319,110 @@ __all__ = [
     "alpha_dropout", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
     "channel_shuffle", "interpolate", "upsample", "label_smooth", "bilinear",
     "pad", "unfold", "fold", "temporal_shift", "class_center_sample",
+    "affine_grid", "grid_sample",
 ]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (reference paddle.nn.functional.
+    affine_grid): theta (N, 2, 3) → grid (N, H, W, 2) in [-1, 1]."""
+    theta = ensure_tensor(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.tolist()
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+        base = jnp.stack(
+            [gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+        # (N, 2, 3) @ (H*W, 3)^T → (N, H*W, 2)
+        out = jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+        return out
+
+    return apply(fn, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW features at normalized grid locations (reference
+    paddle.nn.functional.grid_sample); differentiable through the
+    gathers."""
+    x = ensure_tensor(x)
+    grid = ensure_tensor(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def fn(feat, g):
+        n, c, h, w = feat.shape
+        gx = g[..., 0].astype(jnp.float32)  # (N, Hg, Wg)
+        gy = g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def reflect(v, lo, hi):
+            # triangular-wave reflection into [lo, hi]; in-range values
+            # are fixed points
+            rng = hi - lo
+            if rng <= 0:
+                return jnp.zeros_like(v)
+            return rng - jnp.abs((v - lo) % (2 * rng) - rng) + lo
+
+        if padding_mode == "reflection":
+            if align_corners:  # reflect about pixel centers
+                fx = reflect(fx, 0.0, float(w - 1))
+                fy = reflect(fy, 0.0, float(h - 1))
+            else:  # reference reflects about pixel boundaries
+                fx = reflect(fx, -0.5, float(w) - 0.5)
+                fy = reflect(fy, -0.5, float(h) - 0.5)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            out = jnp.take_along_axis(
+                jnp.take_along_axis(
+                    feat[:, :, :, None, :],  # (N,C,H,1,W)
+                    iyc[:, None, None, :, :].astype(jnp.int32).reshape(
+                        n, 1, 1, -1, 1), axis=2,  # size-1 C broadcasts
+                ).squeeze(2),  # (N,C,Hg*Wg,W)
+                ixc[:, None, :, :].astype(jnp.int32).reshape(
+                    n, 1, -1, 1), axis=3,
+            )[..., 0]  # (N, C, Hg*Wg)
+            valid = ((iy >= 0) & (iy <= h - 1)
+                     & (ix >= 0) & (ix <= w - 1))
+            if padding_mode == "zeros":
+                out = out * valid.reshape(n, 1, -1)
+            return out
+
+        hw = fx.shape[1] * fx.shape[2]
+        if mode == "nearest":
+            out = gather(jnp.round(fy), jnp.round(fx))
+        else:
+            x0 = jnp.floor(fx)
+            y0 = jnp.floor(fy)
+            wx = fx - x0
+            wy = fy - y0
+            v00 = gather(y0, x0)
+            v01 = gather(y0, x0 + 1)
+            v10 = gather(y0 + 1, x0)
+            v11 = gather(y0 + 1, x0 + 1)
+            wxf = wx.reshape(n, 1, hw)
+            wyf = wy.reshape(n, 1, hw)
+            out = ((1 - wyf) * ((1 - wxf) * v00 + wxf * v01)
+                   + wyf * ((1 - wxf) * v10 + wxf * v11))
+        return out.reshape(n, c, fx.shape[1], fx.shape[2]).astype(feat.dtype)
+
+    return apply(fn, x, grid, op_name="grid_sample")

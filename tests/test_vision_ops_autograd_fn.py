@@ -134,3 +134,50 @@ def test_vjp_outputs_stay_on_tape():
     out, g = paddle.autograd.vjp(lambda t: (t ** 3).sum(), x)
     (gg,) = paddle.grad(g.sum(), [x])  # d/dx sum(3x^2) = 6x
     np.testing.assert_allclose(np.asarray(gg._value), [6.0, 12.0], rtol=1e-5)
+
+
+def test_affine_grid_and_grid_sample_identity():
+    import paddle_tpu.nn.functional as F
+
+    x = _t(np.random.RandomState(0).randn(2, 3, 5, 7).astype("f4"))
+    theta = _t(np.tile(np.array([[1, 0, 0], [0, 1, 0]], "f4"), (2, 1, 1)))
+    grid = F.affine_grid(theta, [2, 3, 5, 7])
+    out = F.grid_sample(x, grid)
+    np.testing.assert_allclose(
+        np.asarray(out._value), np.asarray(x._value), rtol=1e-4, atol=1e-4)
+
+
+def test_grid_sample_shift_translates():
+    import paddle_tpu.nn.functional as F
+
+    x = np.zeros((1, 1, 4, 4), "f4")
+    x[0, 0, 1, 1] = 1.0
+    # shift grid by one pixel in x: sample at (col+1)
+    theta = _t(np.array([[[1, 0, 2.0 / 3], [0, 1, 0]]], "f4"))
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = np.asarray(F.grid_sample(_t(x), grid)._value)
+    assert out[0, 0, 1, 0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_grid_sample_grads_flow():
+    import paddle_tpu.nn.functional as F
+
+    x = _t(np.random.RandomState(1).randn(1, 2, 4, 4).astype("f4"))
+    x.stop_gradient = False
+    theta = _t(np.array([[[1, 0, 0.1], [0, 1, -0.1]]], "f4"))
+    grid = F.affine_grid(theta, [1, 2, 4, 4])
+    out = F.grid_sample(x, grid)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+def test_grid_sample_reflection_identity_in_range():
+    import paddle_tpu.nn.functional as F
+
+    x = _t(np.arange(16, dtype="f4").reshape(1, 1, 4, 4))
+    theta = _t(np.array([[[1, 0, 0], [0, 1, 0]]], "f4"))
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = F.grid_sample(x, grid, padding_mode="reflection")
+    np.testing.assert_allclose(
+        np.asarray(out._value), np.asarray(x._value), rtol=1e-5)
