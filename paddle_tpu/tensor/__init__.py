@@ -226,3 +226,11 @@ Tensor.norm = linalg.norm
 Tensor.dist = linalg.dist
 Tensor.cholesky = linalg.cholesky
 Tensor.inverse = linalg.inv
+
+
+def _element_size(self):
+    """Bytes per element (reference Tensor.element_size)."""
+    return int(self._value.dtype.itemsize)
+
+
+Tensor.element_size = _element_size
