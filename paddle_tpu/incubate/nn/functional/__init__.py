@@ -15,7 +15,7 @@ from ....tensor._helpers import ensure_tensor
 __all__ = [
     "fused_rotary_position_embedding", "fused_rms_norm", "fused_layer_norm",
     "fused_linear", "fused_bias_act", "fused_multi_head_attention",
-    "fused_feedforward",
+    "fused_feedforward", "masked_multihead_attention",
 ]
 
 
@@ -128,3 +128,64 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     if not pre_layer_norm:
         out = _F.layer_norm(out, [e], ln2_scale, ln2_bias, ln2_epsilon)
     return out
+
+
+def masked_multihead_attention(x, cache_kv=None, src_mask=None,
+                               sequence_lengths=None, out_scale=-1,
+                               num_heads=None, name=None, **kwargs):
+    """One-token decode attention over a KV cache (reference:
+    paddle.incubate.nn.functional.masked_multihead_attention, the
+    fused decode op behind fused_multi_transformer). Routes to the
+    Pallas decode kernel on TPU (masked XLA attention elsewhere or when
+    ``src_mask`` needs arbitrary biasing).
+
+    x: (B, H, D) or (B, 1, H, D) new-token queries; cache_kv:
+    (2, B, S_max, HK, D) stacked k/v caches (paddle layout);
+    sequence_lengths: (B,) valid entries incl. the new token;
+    src_mask: optional additive bias broadcastable to (B, H, 1, S_max).
+    Quant fusion (out_scale > 0) is not supported here."""
+    import jax
+    import jax.numpy as jnp
+    from ....core.flags import get_flags
+    from ....tensor._helpers import apply
+
+    if cache_kv is None or sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention requires cache_kv and "
+            "sequence_lengths"
+        )
+    if out_scale and out_scale > 0:
+        raise NotImplementedError(
+            "masked_multihead_attention: out_scale quant fusion is not "
+            "supported; quantize via paddle.quantization instead"
+        )
+    x = ensure_tensor(x)
+    cache_kv = ensure_tensor(cache_kv)
+    sequence_lengths = ensure_tensor(sequence_lengths)
+    if src_mask is not None:
+        src_mask = ensure_tensor(src_mask)
+
+    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
+    use_pallas = (
+        flags["FLAGS_use_pallas_kernels"]
+        and (jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
+        and src_mask is None  # arbitrary bias → XLA path
+    )
+
+    def fn(q, ckv, lens, *maybe_mask):
+        kc, vc = ckv[0], ckv[1]
+        if use_pallas:
+            from ....ops.pallas.decode_attention import decode_attention
+
+            return decode_attention(q, kc, vc, lens.astype(jnp.int32))
+        from ..fused_transformer import _masked_decode_attn as _mda
+
+        q4 = q if q.ndim == 4 else q[:, None]
+        out = _mda(q4, kc, vc, lens,
+                   bias=maybe_mask[0] if maybe_mask else None)
+        return out if q.ndim == 4 else out[:, 0]
+
+    args = [x, cache_kv, sequence_lengths]
+    if src_mask is not None:
+        args.append(src_mask)
+    return apply(fn, *args, op_name="masked_multihead_attention")

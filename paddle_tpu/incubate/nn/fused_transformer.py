@@ -243,8 +243,10 @@ def _fused_stack(src, kc, vc, lens, wt, cfg: FusedMultiTransformer, offset):
     return hidden, new_kc, new_vc
 
 
-def _masked_decode_attn(q, kc, vc, lens):
-    """CPU/interpret decode path: masked attention over the cache prefix."""
+def _masked_decode_attn(q, kc, vc, lens, bias=None):
+    """CPU/interpret decode path: masked attention over the cache prefix.
+    ``bias``: optional additive logits bias broadcastable to
+    (B, H, Sq, S_max)."""
     b, s, h, d = q.shape
     hk = kc.shape[2]
     rep = h // hk
@@ -253,6 +255,8 @@ def _masked_decode_attn(q, kc, vc, lens):
     sc = 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         kr.astype(jnp.float32)) * sc
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     mask = jnp.arange(kr.shape[1])[None, :] < lens[:, None]
     logits = jnp.where(mask[:, None, None, :], logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
