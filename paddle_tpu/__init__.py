@@ -114,3 +114,5 @@ from . import signal  # noqa: E402
 from . import geometric  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
+from . import utils  # noqa: E402
+from . import sysconfig  # noqa: E402

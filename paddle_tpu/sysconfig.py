@@ -1,0 +1,15 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py — unverified):
+install-layout introspection."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "lib")

@@ -1,0 +1,66 @@
+"""paddle.utils (reference: python/paddle/utils/ — unverified,
+SURVEY.md §0): install check + misc helpers."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["run_check", "try_import", "unique_name"]
+
+
+def run_check():
+    """The classic install smoke test (reference paddle.utils.run_check):
+    runs a small matmul forward+backward on the current device and, when
+    more devices are visible, a sharded matmul over the mesh."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+
+    dev = paddle.get_device()
+    print(f"Running verify PaddlePaddle(TPU-native) program on {dev} ...")
+    x = paddle.to_tensor(np.random.rand(16, 32).astype("float32"))
+    x.stop_gradient = False
+    w = paddle.to_tensor(np.random.rand(32, 8).astype("float32"))
+    w.stop_gradient = False
+    loss = (x @ w).sum()
+    loss.backward()
+    assert x.grad is not None and w.grad is not None
+    n = len(jax.devices())
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        xs = jax.device_put(
+            x._value, NamedSharding(mesh, PartitionSpec("dp", None)))
+        (xs @ w._value).sum().block_until_ready()
+        print(f"PaddlePaddle(TPU-native) works well on {n} devices.")
+    print(
+        "PaddlePaddle(TPU-native) is installed successfully! "
+        "Let's start deep learning with PaddlePaddle now."
+    )
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module with a friendly error (reference
+    paddle.utils.try_import)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed"
+        ) from e
+
+
+class _UniqueNames:
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        i = self._counters.get(key, 0)
+        self._counters[key] = i + 1
+        return f"{key}_{i}"
+
+
+unique_name = _UniqueNames()

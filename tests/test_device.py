@@ -30,3 +30,14 @@ def test_synchronize_and_stream_facades():
     e = s.record_event()
     assert e.query()
     e.synchronize()
+
+
+def test_run_check():
+    paddle.utils.run_check()
+
+
+def test_unique_name_and_sysconfig():
+    a = paddle.utils.unique_name.generate("w")
+    b = paddle.utils.unique_name.generate("w")
+    assert a != b
+    assert paddle.sysconfig.get_include().endswith("include")
