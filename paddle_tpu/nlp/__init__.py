@@ -20,3 +20,4 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification, BertPretrainingCriterion,
     ErnieConfig, ErnieModel, ErnieForPretraining,
 )
+from .t5 import T5Config, T5Model, T5ForConditionalGeneration  # noqa: F401

@@ -1,0 +1,305 @@
+"""T5 encoder-decoder family (reference: PaddleNLP
+paddlenlp/transformers/t5/modeling.py — unverified, SURVEY.md §0).
+
+Completes the architecture triad (decoder-only Llama/GPT, encoder-only
+BERT/ERNIE, encoder-decoder T5) on the framework's own stack: RMS-style
+T5 LayerNorm, relative-position-bucket attention bias (shared across
+layers per stack, reference behavior), ReLU or gated-GELU MLP, tied
+embeddings — all through the dispatch seam so jit/AMP/sharding apply."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding, Dropout, LayerList
+from ..nn.layer.norm import RMSNorm
+from ..nn import functional as F
+from ..tensor._helpers import Tensor, apply, ensure_tensor
+
+__all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration"]
+
+
+class T5Config:
+    def __init__(self, vocab_size=32128, d_model=512, d_kv=64, d_ff=2048,
+                 num_layers=6, num_decoder_layers=None, num_heads=8,
+                 relative_attention_num_buckets=32,
+                 relative_attention_max_distance=128,
+                 dropout_rate=0.1, layer_norm_epsilon=1e-6,
+                 feed_forward_proj="relu", tie_word_embeddings=True,
+                 pad_token_id=0, decoder_start_token_id=0):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.d_kv = d_kv
+        self.d_ff = d_ff
+        self.num_layers = num_layers
+        self.num_decoder_layers = num_decoder_layers or num_layers
+        self.num_heads = num_heads
+        self.relative_attention_num_buckets = relative_attention_num_buckets
+        self.relative_attention_max_distance = relative_attention_max_distance
+        self.dropout_rate = dropout_rate
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.feed_forward_proj = feed_forward_proj
+        self.tie_word_embeddings = tie_word_embeddings
+        self.pad_token_id = pad_token_id
+        self.decoder_start_token_id = decoder_start_token_id
+
+    @classmethod
+    def tiny(cls, **overrides):
+        cfg = dict(vocab_size=128, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4, dropout_rate=0.0)
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+def _relative_position_bucket(relative_position, bidirectional, num_buckets,
+                              max_distance):
+    """T5's log-bucketed relative positions (jnp, traced-safe)."""
+    import jax.numpy as jnp
+
+    rp = relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = (rp > 0).astype(jnp.int32) * num_buckets
+        rp = jnp.abs(rp)
+    else:
+        ret = jnp.zeros_like(rp)
+        rp = jnp.maximum(-rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    large = max_exact + (
+        jnp.log(rp.astype(jnp.float32) / max_exact + 1e-6)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, rp, large)
+
+
+class T5Attention(Layer):
+    def __init__(self, config: T5Config, has_relative_bias=False,
+                 bidirectional=True):
+        super().__init__()
+        self.cfg = config
+        inner = config.num_heads * config.d_kv
+        self.q = Linear(config.d_model, inner, bias_attr=False)
+        self.k = Linear(config.d_model, inner, bias_attr=False)
+        self.v = Linear(config.d_model, inner, bias_attr=False)
+        self.o = Linear(inner, config.d_model, bias_attr=False)
+        self.has_relative_bias = has_relative_bias
+        self.bidirectional = bidirectional
+        if has_relative_bias:
+            self.relative_attention_bias = Embedding(
+                config.relative_attention_num_buckets, config.num_heads)
+
+    def compute_bias(self, q_len, k_len):
+        """(1, H, Sq, Sk) additive bias from bucketed relative positions."""
+        import jax.numpy as jnp
+
+        table = self.relative_attention_bias.weight
+
+        def fn(tbl):
+            ctx = jnp.arange(q_len)[:, None]
+            mem = jnp.arange(k_len)[None, :]
+            buckets = _relative_position_bucket(
+                mem - ctx, self.bidirectional,
+                self.cfg.relative_attention_num_buckets,
+                self.cfg.relative_attention_max_distance,
+            )
+            return jnp.transpose(tbl[buckets], (2, 0, 1))[None]
+
+        return apply(fn, table, op_name="t5_relative_bias")
+
+    def forward(self, hidden, key_value=None, bias=None, causal=False):
+        import jax
+        import jax.numpy as jnp
+
+        b, sq, _ = hidden.shape
+        kv_src = key_value if key_value is not None else hidden
+        sk = kv_src.shape[1]
+        H, D = self.cfg.num_heads, self.cfg.d_kv
+        q = self.q(hidden).reshape([b, sq, H, D])
+        k = self.k(kv_src).reshape([b, sk, H, D])
+        v = self.v(kv_src).reshape([b, sk, H, D])
+
+        drop = self.cfg.dropout_rate if self.training else 0.0
+        rng_key = None
+        if drop > 0.0:
+            from ..core.random import next_key
+
+            rng_key = next_key()
+
+        def attn(qv, kv, vv, *maybe_bias):
+            # NOTE: T5 does NOT scale by 1/sqrt(d)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", qv.astype(jnp.float32),
+                                kv.astype(jnp.float32))
+            if maybe_bias:
+                logits = logits + maybe_bias[0].astype(jnp.float32)
+            if causal:
+                cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                logits = jnp.where(cm[None, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            if rng_key is not None:  # reference drops attention probs too
+                keep = jax.random.bernoulli(rng_key, 1.0 - drop, p.shape)
+                p = jnp.where(keep, p / (1.0 - drop), 0.0)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p, vv.astype(jnp.float32))
+            return out.astype(qv.dtype)
+
+        args = [q, k, v]
+        if bias is not None:
+            args.append(ensure_tensor(bias))
+        out = apply(attn, *args, op_name="t5_attention")
+        return self.o(out.reshape([b, sq, H * D]))
+
+
+class T5FF(Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.gated = config.feed_forward_proj.startswith("gated")
+        if self.gated:
+            self.wi_0 = Linear(config.d_model, config.d_ff, bias_attr=False)
+            self.wi_1 = Linear(config.d_model, config.d_ff, bias_attr=False)
+        else:
+            self.wi = Linear(config.d_model, config.d_ff, bias_attr=False)
+        self.wo = Linear(config.d_ff, config.d_model, bias_attr=False)
+
+    def forward(self, x):
+        if self.gated:
+            # reference gated-gelu uses the tanh-approximate form
+            return self.wo(
+                F.gelu(self.wi_0(x), approximate=True) * self.wi_1(x))
+        return self.wo(F.relu(self.wi(x)))
+
+
+class T5Block(Layer):
+    def __init__(self, config: T5Config, is_decoder, has_relative_bias):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.is_decoder = is_decoder
+        self.ln1 = RMSNorm(config.d_model, epsilon=eps)
+        self.self_attn = T5Attention(
+            config, has_relative_bias, bidirectional=not is_decoder)
+        if is_decoder:
+            self.ln_cross = RMSNorm(config.d_model, epsilon=eps)
+            self.cross_attn = T5Attention(config, False)
+        self.ln2 = RMSNorm(config.d_model, epsilon=eps)
+        self.ff = T5FF(config)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, hidden, bias=None, memory=None, memory_bias=None):
+        h = hidden + self.dropout(self.self_attn(
+            self.ln1(hidden), bias=bias, causal=self.is_decoder))
+        if self.is_decoder and memory is not None:
+            h = h + self.dropout(self.cross_attn(
+                self.ln_cross(h), key_value=memory, bias=memory_bias))
+        return h + self.dropout(self.ff(self.ln2(h)))
+
+
+class T5Stack(Layer):
+    def __init__(self, config: T5Config, is_decoder, embed):
+        super().__init__()
+        self.cfg = config
+        self.is_decoder = is_decoder
+        self.embed_tokens = embed
+        n = (config.num_decoder_layers if is_decoder else config.num_layers)
+        self.blocks = LayerList([
+            T5Block(config, is_decoder, has_relative_bias=(i == 0))
+            for i in range(n)
+        ])
+        self.final_layer_norm = RMSNorm(
+            config.d_model, epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, input_ids, memory=None, attention_mask=None,
+                memory_mask=None):
+        hidden = self.dropout(self.embed_tokens(input_ids))
+        s = hidden.shape[1]
+        # reference behavior: layer-0's bias table is shared by ALL layers
+        bias = self.blocks[0].self_attn.compute_bias(s, s)
+        if attention_mask is not None:
+            bias = bias + attention_mask
+        memory_bias = memory_mask
+        out = hidden
+        for block in self.blocks:
+            out = block(out, bias=bias, memory=memory,
+                        memory_bias=memory_bias)
+        return self.dropout(self.final_layer_norm(out))
+
+
+class T5Model(Layer):
+    def __init__(self, config: T5Config = None, **kw):
+        super().__init__()
+        cfg = config or T5Config(**kw)
+        self.config = cfg
+        self.shared = Embedding(cfg.vocab_size, cfg.d_model)
+        self.encoder = T5Stack(cfg, is_decoder=False, embed=self.shared)
+        self.decoder = T5Stack(cfg, is_decoder=True, embed=self.shared)
+
+    @staticmethod
+    def _pad_bias(input_ids, pad_id):
+        """(B, S) ids → additive (B, 1, 1, S) bias masking pad keys."""
+        import jax.numpy as jnp
+
+        ids = ensure_tensor(input_ids)
+        return apply(
+            lambda v: jnp.where(
+                (v != pad_id)[:, None, None, :], 0.0, -1e30
+            ).astype(jnp.float32),
+            ids, op_name="t5_pad_bias",
+        )
+
+    def forward(self, input_ids, decoder_input_ids, attention_mask=None):
+        pad = self.config.pad_token_id
+        enc_bias = (self._pad_bias(input_ids, pad)
+                    if attention_mask is None
+                    else ensure_tensor(attention_mask))
+        memory = self.encoder(input_ids, attention_mask=enc_bias)
+        dec = self.decoder(decoder_input_ids, memory=memory,
+                           memory_mask=enc_bias)
+        return dec, memory
+
+
+class T5ForConditionalGeneration(Layer):
+    def __init__(self, config: T5Config = None, **kw):
+        super().__init__()
+        cfg = config or T5Config(**kw)
+        self.config = cfg
+        self.t5 = T5Model(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = Linear(cfg.d_model, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, decoder_input_ids, labels=None,
+                attention_mask=None):
+        hidden, _ = self.t5(input_ids, decoder_input_ids,
+                            attention_mask=attention_mask)
+        if self.config.tie_word_embeddings:
+            # reference: tied head scales hidden by d_model^-0.5
+            hidden = hidden * (self.config.d_model ** -0.5)
+            logits = F.linear(hidden, self.t5.shared.weight.t())
+        else:
+            logits = self.lm_head(hidden)
+        if labels is not None:
+            ce = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                ensure_tensor(labels).reshape([-1]),
+                ignore_index=-100,
+            )
+            return ce, logits
+        return logits
+
+    def prepare_decoder_input_ids(self, labels):
+        """Shift-right with decoder_start_token_id (reference helper)."""
+        import jax.numpy as jnp
+
+        labels = ensure_tensor(labels)
+
+        def fn(lab):
+            start = jnp.full((lab.shape[0], 1),
+                             self.config.decoder_start_token_id, lab.dtype)
+            shifted = jnp.concatenate([start, lab[:, :-1]], axis=1)
+            return jnp.where(shifted == -100, self.config.pad_token_id,
+                             shifted)
+
+        return apply(fn, labels, op_name="t5_shift_right")

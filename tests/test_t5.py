@@ -1,0 +1,122 @@
+"""T5 encoder-decoder family: shapes, relative-bias buckets, training,
+jitted step, shift-right."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import T5Config, T5Model, T5ForConditionalGeneration
+
+
+def _ids(b=2, s=12, vocab=128, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(1, vocab, (b, s)))
+
+
+def test_t5_model_shapes():
+    paddle.seed(0)
+    m = T5Model(T5Config.tiny())
+    dec, mem = m(_ids(), _ids(s=8, seed=1))
+    assert dec.shape == [2, 8, 32] and mem.shape == [2, 12, 32]
+
+
+def test_relative_bucket_properties():
+    import jax.numpy as jnp
+    from paddle_tpu.nlp.t5 import _relative_position_bucket
+
+    rp = jnp.arange(-20, 21)
+    b_bi = _relative_position_bucket(rp, True, 32, 128)
+    assert int(b_bi.min()) >= 0 and int(b_bi.max()) < 32
+    # bidirectional: sign separates bucket halves
+    assert int(b_bi[0]) < 16 and int(b_bi[-1]) >= 16
+    b_causal = _relative_position_bucket(rp, False, 32, 128)
+    # causal: future positions (rp>0) all collapse to bucket 0
+    np.testing.assert_array_equal(np.asarray(b_causal[rp > 0]), 0)
+
+
+def test_t5_train_step_decreases_loss():
+    paddle.seed(0)
+    cfg = T5Config.tiny()
+    m = T5ForConditionalGeneration(cfg)
+    opt = paddle.optimizer.AdamW(5e-3, parameters=m.parameters())
+    src = _ids()
+    labels = _ids(s=8, seed=2)
+    dec_in = m.prepare_decoder_input_ids(labels)
+    losses = []
+    for _ in range(6):
+        loss, _ = m(src, dec_in, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_t5_shift_right():
+    m = T5ForConditionalGeneration(T5Config.tiny(decoder_start_token_id=7))
+    labels = paddle.to_tensor(np.array([[5, 6, -100]], "i8"))
+    shifted = m.prepare_decoder_input_ids(labels)
+    np.testing.assert_array_equal(
+        np.asarray(shifted._value), [[7, 5, 6]])
+
+
+def test_t5_jitted_train_step():
+    from paddle_tpu.jit.train import JittedTrainStep
+
+    paddle.seed(0)
+    cfg = T5Config.tiny()
+    m = T5ForConditionalGeneration(cfg)
+
+    def criterion(out, labels):
+        # model called with labels packed in inputs; out is logits
+        import paddle_tpu.nn.functional as F
+
+        return F.cross_entropy(
+            out.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
+
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = JittedTrainStep(m, criterion, opt)
+    src = _ids()
+    dec_in = _ids(s=8, seed=3)
+    labels = _ids(s=8, seed=4)
+    l1 = float(step([src, dec_in], labels))
+    l2 = float(step([src, dec_in], labels))
+    assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_t5_decoder_is_causal():
+    """Changing a future decoder token must not affect earlier logits."""
+    paddle.seed(0)
+    m = T5ForConditionalGeneration(T5Config.tiny())
+    m.eval()
+    src = _ids()
+    dec = np.asarray(_ids(s=8, seed=5)._value).copy()
+    out1 = np.asarray(m(src, paddle.to_tensor(dec))._value)
+    dec2 = dec.copy()
+    dec2[:, -1] = (dec2[:, -1] + 1) % 120 + 1
+    out2 = np.asarray(m(src, paddle.to_tensor(dec2))._value)
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_t5_pads_masked_from_encoder_and_cross_attention():
+    """Changing pad tokens in the source must not change outputs."""
+    paddle.seed(0)
+    cfg = T5Config.tiny(pad_token_id=0)
+    m = T5ForConditionalGeneration(cfg)
+    m.eval()
+    src = np.asarray(_ids()._value).copy()
+    src[:, 8:] = 0  # padding
+    dec = _ids(s=6, seed=6)
+    out1 = np.asarray(m(paddle.to_tensor(src), dec)._value)
+    src2 = src.copy()
+    src2[:, 8:] = 0  # same pads; now alter a PADDED position's id? can't
+    # instead: compare against explicitly masked call — must be identical
+    bias = np.where((src != 0)[:, None, None, :], 0.0, -1e30).astype("f4")
+    out2 = np.asarray(
+        m(paddle.to_tensor(src), dec,
+          attention_mask=paddle.to_tensor(bias))._value)
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+    # and padding length must not leak: longer padding, same content
+    src3 = np.concatenate([src, np.zeros((2, 4), src.dtype)], axis=1)
+    out3 = np.asarray(m(paddle.to_tensor(src3), dec)._value)
+    np.testing.assert_allclose(out1, out3, rtol=1e-4, atol=1e-5)
