@@ -65,6 +65,12 @@ def distributed_model(model):
     hcg = _fleet_state["hcg"]
     hc = strategy.hybrid_configs
     if int(hc["pp_degree"]) > 1:
+        if getattr(model, "_num_virtual", 1) > 1:
+            from .meta_parallel.pipeline_parallel import (
+                PipelineParallelWithInterleave,
+            )
+
+            return PipelineParallelWithInterleave(model, hcg, strategy)
         return PipelineParallel(model, hcg, strategy)
     if int(hc["mp_degree"]) > 1:
         return TensorParallel(model, hcg, strategy)
