@@ -71,7 +71,12 @@ def _check_nan_inf(name, flat_vals):
             if isinstance(v, jax.core.Tracer):
                 # jitted path: a host callback carries the check into the
                 # compiled program (debug-flag overhead is acceptable —
-                # the reference's check_nan_inf pass also syncs)
+                # the reference's check_nan_inf pass also syncs). The
+                # callback's raise aborts the computation: it surfaces as
+                # JaxRuntimeError("CpuCallback error ... FloatingPointError
+                # ... NaN/Inf") at dispatch or first sync — verified on
+                # jax 0.9 by tests/test_distributed.py::
+                # test_nan_check_fires_inside_jit
                 jax.debug.callback(
                     _nan_report, name, jnp.any(~jnp.isfinite(v))
                 )

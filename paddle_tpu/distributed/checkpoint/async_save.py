@@ -57,19 +57,19 @@ def _snapshot(state_dict):
 def async_save_state_dict(state_dict, path, process_group=None,
                           coordinator_rank=0):
     """Snapshot synchronously, write in the background. Returns an
-    ``AsyncSaveHandle``; the write is atomic (tmp dir + rename)."""
+    ``AsyncSaveHandle``. Every file is published via tmp+rename inside
+    ``path`` (per-file atomic); the directory itself is never swapped or
+    deleted, because on multi-process runs each rank contributes its own
+    ``shard_<pid>.npz`` to the SAME directory — a rank-level dir swap
+    would tear away the other ranks' shards. Readers should gate on a
+    completion marker (``CheckpointManager`` publishes LATEST only after
+    the save finishes)."""
     snap = _snapshot(state_dict)
     errbox: list = []
 
     def run():
-        tmp = path + ".tmp"
         try:
-            if os.path.isdir(tmp):
-                shutil.rmtree(tmp)
-            save_state_dict(snap, tmp, process_group, coordinator_rank)
-            if os.path.isdir(path):
-                shutil.rmtree(path)
-            os.replace(tmp, path)
+            save_state_dict(snap, path, process_group, coordinator_rank)
         except BaseException as e:  # surfaced via handle.result()
             errbox.append(e)
 

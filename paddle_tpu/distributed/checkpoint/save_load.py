@@ -37,10 +37,31 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
         # gather addressable shards; single-controller saves the global view
         arrays[key.replace("/", "__")] = np.asarray(jax.device_get(v))
     pid = jax.process_index()
+    # every file lands via tmp+rename so a concurrent reader (or another
+    # rank publishing into the same directory) never sees a torn file,
+    # and no rank ever deletes a directory other ranks write into
+    world = jax.process_count()
     if pid == coordinator_rank:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
+        meta["__world_size__"] = {"kind": "object", "value": world}
+        mpath = os.path.join(path, "metadata.json")
+        with open(mpath + ".tmp", "w") as f:
             json.dump(meta, f, indent=1, default=str)
-    np.savez(os.path.join(path, f"shard_{pid}.npz"), **arrays)
+        os.replace(mpath + ".tmp", mpath)
+        # drop shards a previous (larger-world) save left behind: no rank
+        # of the current world writes indices >= world, and a stale shard
+        # would otherwise win over fresh weights at load time
+        for fname in os.listdir(path):
+            if fname.startswith("shard_") and fname.endswith(".npz"):
+                try:
+                    idx = int(fname[6:-4])
+                except ValueError:
+                    continue
+                if idx >= world:
+                    os.unlink(os.path.join(path, fname))
+    # dotted tmp name: never matches load's shard_*.npz glob
+    tmp = os.path.join(path, f".tmp_shard_{pid}.npz")
+    np.savez(tmp, **arrays)
+    os.replace(tmp, os.path.join(path, f"shard_{pid}.npz"))
 
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
@@ -48,9 +69,17 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
     tensor's current NamedSharding."""
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
+    ws = meta.get("__world_size__")
+    world = ws.get("value") if isinstance(ws, dict) else None
     data = {}
     for fname in sorted(os.listdir(path)):
         if fname.startswith("shard_") and fname.endswith(".npz"):
+            if world is not None:
+                try:
+                    if int(fname[6:-4]) >= int(world):
+                        continue  # stale shard from a larger world
+                except ValueError:
+                    pass
             with np.load(os.path.join(path, fname)) as z:
                 for k in z.files:
                     data[k] = z[k]

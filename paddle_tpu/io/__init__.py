@@ -291,20 +291,64 @@ def default_collate_fn(batch):
     return batch
 
 
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, ValueError):
+        return False
+    except PermissionError:
+        return True
+
+
 def _claim_worker_id(claim_dir):
     """Filesystem-based worker-id counter: O_EXCL slot files work across
     any spawn boundary (mp.Value's SemLock does not survive pickling to
-    a spawned pool worker in sandboxed environments)."""
+    a spawned pool worker in sandboxed environments). Slots record the
+    claimant's pid so a worker respawned after a pool-mate died can
+    reclaim the dead slot (keeping ids < num_workers) instead of
+    counting upward forever."""
     i = 0
     while True:
+        slot = os.path.join(claim_dir, f"w{i}")
         try:
-            fd = os.open(
-                os.path.join(claim_dir, f"w{i}"),
-                os.O_CREAT | os.O_EXCL | os.O_WRONLY,
-            )
+            fd = os.open(slot, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
             os.close(fd)
             return i
         except FileExistsError:
+            # dead claimant? take over via an exclusive reap marker so
+            # only one respawned worker recycles the slot
+            try:
+                with open(slot) as f:
+                    owner = int(f.read().strip() or -1)
+            except (OSError, ValueError):
+                owner = -1
+            if owner != -1 and not _pid_alive(owner):
+                try:
+                    rfd = os.open(
+                        slot + ".reap", os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                    )
+                except FileExistsError:
+                    i += 1
+                    continue
+                try:
+                    # re-check under the marker: another reaper may have
+                    # recycled this slot between our read and the win
+                    try:
+                        with open(slot) as f:
+                            owner = int(f.read().strip() or -1)
+                    except (OSError, ValueError):
+                        owner = -1
+                    if owner == -1 or _pid_alive(owner):
+                        i += 1
+                        continue
+                    with open(slot, "w") as f:
+                        f.write(str(os.getpid()))
+                    return i
+                finally:
+                    os.close(rfd)
+                    os.unlink(slot + ".reap")
             i += 1
 
 
@@ -408,6 +452,7 @@ class DataLoader:
         self.persistent_workers = persistent_workers
         self.timeout = timeout
         self._executor = None
+        self._claim_dir = None
         self._picklable_ok = None  # decided once, on first iteration
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
@@ -476,7 +521,7 @@ class DataLoader:
             import tempfile
 
             ctx = mp.get_context("spawn")
-            claim_dir = tempfile.mkdtemp(prefix="pdtpu_dl_")
+            claim_dir = self._claim_dir = tempfile.mkdtemp(prefix="pdtpu_dl_")
             collate = (None if self.collate_fn is default_collate_fn
                        else self.collate_fn)
             ex = ProcessPoolExecutor(
@@ -525,8 +570,27 @@ class DataLoader:
                     timeout=self.timeout or None))
         finally:
             if not self.persistent_workers:
-                ex.shutdown(wait=False, cancel_futures=True)
-                self._executor = None
+                self.close()
+
+    def close(self):
+        """Shut down pool workers (also for ``persistent_workers=True``)
+        and remove the worker-id claim directory. Idempotent; called
+        automatically at the end of each epoch for non-persistent pools
+        and from ``__del__`` otherwise."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+        if self._claim_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._claim_dir, ignore_errors=True)
+            self._claim_dir = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         if self.num_workers == 0:

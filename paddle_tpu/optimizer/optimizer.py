@@ -721,9 +721,11 @@ class LBFGS(Optimizer):
         return jnp.concatenate([v.astype(jnp.float32).reshape(-1)
                                 for v in vals])
 
-    def _unflat(self, flat):
+    def _unflat(self, flat, params):
+        # must walk the SAME param subset the flat vector was built from
+        # (frozen/no-grad params are excluded by step)
         out, off = [], 0
-        for p in self._parameter_list:
+        for p in params:
             n = int(np.prod(p._value.shape)) if p._value.ndim else 1
             out.append(flat[off: off + n].reshape(p._value.shape))
             off += n
@@ -774,7 +776,7 @@ class LBFGS(Optimizer):
             if float(jnp.max(jnp.abs(step_vec))) <= self.tol_change:
                 break
             new_flat = flat_p + step_vec
-            for p, v in zip(params, self._unflat(new_flat)):
+            for p, v in zip(params, self._unflat(new_flat, params)):
                 p._value = v.astype(p._value.dtype)
         return loss
 

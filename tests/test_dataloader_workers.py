@@ -87,3 +87,24 @@ def test_worker_init_fn_runs():
         worker_init_fn=_init_fn,
     )
     assert len(list(dl)) == 2
+
+
+def test_close_cleans_claim_dir_and_pool():
+    """Regression (round-2 advisor): the worker-id claim dir must not
+    leak, and persistent pools must be shut down by close()."""
+    import os
+
+    ds = RangeSquares(16)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    list(dl)
+    claim = dl._claim_dir
+    assert claim is not None and os.path.isdir(claim)
+    assert dl._executor is not None  # persistent: survives the epoch
+    dl.close()
+    assert dl._executor is None
+    assert not os.path.exists(claim)
+    # non-persistent: epoch end cleans up automatically
+    dl2 = DataLoader(ds, batch_size=4, num_workers=2)
+    list(dl2)
+    assert dl2._executor is None and dl2._claim_dir is None

@@ -101,3 +101,37 @@ def test_preemption_guard_restores_handler():
         os.kill(os.getpid(), signal.SIGTERM)
         assert g.preempted
     assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_async_save_publishes_per_file_and_gcs_stale_shards(tmp_path):
+    """Regression (round-2 advisor): the save publishes per-file (never
+    swapping/deleting the shared directory, which on multi-process runs
+    holds other live ranks' shards), while shards from a LARGER previous
+    world — which no current rank overwrites — are GC'd so a stale
+    later-sorted shard can't shadow fresh weights at load time."""
+    import json
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    path = str(tmp_path / "ckpt")
+    os.makedirs(path)
+    stale = os.path.join(path, "shard_99.npz")
+    np.savez(stale, other=np.ones(3))
+
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    handle = async_save_state_dict(m.state_dict(), path)
+    handle.result()
+    assert not os.path.exists(stale), "stale larger-world shard kept"
+    assert os.path.exists(os.path.join(path, "shard_0.npz"))
+    with open(os.path.join(path, "metadata.json")) as f:
+        assert json.load(f)["__world_size__"]["value"] == 1
+    # no stray tmp artifacts left behind
+    assert not [f for f in os.listdir(path) if "tmp" in f]
+    # roundtrip still resolves to the fresh weights
+    m2 = nn.Linear(4, 4)
+    load_state_dict(m2.state_dict(), path)
+    np.testing.assert_array_equal(
+        np.asarray(m2.weight._value), np.asarray(m.weight._value))

@@ -279,3 +279,28 @@ def test_asgd_averages_gradients():
         opt.clear_grad()
     # alternating +-1 grads with window 2 → net movement ~ first step only
     assert abs(float(w._value[0])) < 0.2
+
+
+def test_lbfgs_frozen_param_offsets_stay_aligned():
+    """Regression (round-2 advisor): a no-grad param in the parameter
+    list must not desync the flatten/unflatten offsets."""
+    paddle.seed(0)
+    target = np.random.RandomState(3).randn(4).astype("f4")
+    frozen = paddle.to_tensor(np.full((3, 2), 7.0, "f4"))  # stop_gradient
+    w = paddle.to_tensor(np.zeros(4, "f4"))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(
+        learning_rate=0.5, max_iter=4, parameters=[frozen, w])
+
+    def closure():
+        opt.clear_grad()
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        opt.step(closure)
+    np.testing.assert_allclose(
+        np.asarray(w._value), target, rtol=1e-2, atol=1e-2)
+    np.testing.assert_array_equal(np.asarray(frozen._value),
+                                  np.full((3, 2), 7.0, "f4"))
