@@ -20,6 +20,9 @@ from ...core.flags import get_flags
 # Imported eagerly so a broken kernel package fails loudly at import time
 # instead of silently falling back at every call (round-1 advisor finding).
 from ...ops.pallas.flash_attention import flash_attention as _pallas_flash
+from ...ops.pallas.varlen_flash_attention import (
+    varlen_flash_attention as _pallas_varlen_flash,
+)
 
 
 def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
@@ -107,6 +110,42 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _xla_varlen_attention(q, k, v, cu_q, cu_k, scale, causal,
+                          dropout_p=0.0, key=None):
+    """Segment-masked XLA reference for packed varlen attention (O(T^2)
+    memory) — the numeric oracle for the Pallas kernel and the off-TPU /
+    dropout path. Supports GQA and unequal q/kv lengths (bottom-right
+    causal)."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    pos_q = jnp.arange(q.shape[0])
+    pos_k = jnp.arange(k.shape[0])
+    seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
+    seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
+    logits = jnp.einsum(
+        "qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        # bottom-right alignment per segment (kv coordinates)
+        lq = cu_q[seg_q + 1] - cu_q[seg_q]
+        lk = cu_k[seg_q + 1] - cu_k[seg_q]
+        rel_q = pos_q - cu_q[seg_q] + lk - lq
+        rel_k = pos_k - cu_k[seg_k]
+        mask = mask & (rel_q[:, None] >= rel_k[None, :])
+    logits = jnp.where(mask[None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # fully-masked rows (empty segments) produce nan; zero them
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
@@ -114,29 +153,40 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         name=None):
     """Varlen flash attention: (total_tokens, H, D) + cumulative seqlens.
 
-    Implemented as segment-masked XLA attention (O(n^2) memory): segments
-    are derived from cu_seqlens and masked in the logits. A blockwise
-    Pallas varlen kernel is a future optimization.
+    On TPU this runs the blockwise Pallas varlen kernel
+    (`ops/pallas/varlen_flash_attention.py`): per-q-block kv-block
+    skipping from the segment bounds, O(sum len_i^2) compute and O(T)
+    memory. Off-TPU it falls back to segment-masked XLA attention.
     """
     query, key_, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     cu_q = ensure_tensor(cu_seqlens_q)
+    cu_k = ensure_tensor(cu_seqlens_k)
 
-    def fn(q, k, v, cq):
-        # build segment ids from cumulative lens: token i in segment s
-        total = q.shape[0]
-        pos = jnp.arange(total)
-        seg = jnp.searchsorted(cq[1:], pos, side="right")
-        sc = scale
-        logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sc
-        seg_mask = seg[:, None] == seg[None, :]
-        if causal:
-            seg_mask = seg_mask & (pos[:, None] >= pos[None, :])
-        logits = jnp.where(seg_mask[None], logits, -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
-        return out.astype(q.dtype)
+    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
+    use_pallas = (
+        flags["FLAGS_use_pallas_kernels"]
+        and (dropout == 0.0 or not training)
+        and (jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
+    )
+    if use_pallas:
+        out = apply(
+            lambda q, k, v, cq, ck: _pallas_varlen_flash(
+                q, k, v, cq, ck, causal=causal, sm_scale=scale),
+            query, key_, value, cu_q, cu_k, op_name="flash_attn_unpadded",
+        )
+        return out, None
 
-    out = apply(fn, query, key_, value, cu_q, op_name="flash_attn_unpadded")
+    rng_key = None
+    if dropout > 0.0 and training:
+        from ...core.random import next_key
+
+        rng_key = next_key()
+    out = apply(
+        lambda q, k, v, cq, ck: _xla_varlen_attention(
+            q, k, v, cq, ck, scale, causal,
+            dropout_p=dropout if training else 0.0, key=rng_key),
+        query, key_, value, cu_q, cu_k, op_name="flash_attn_unpadded",
+    )
     return out, None
 
 

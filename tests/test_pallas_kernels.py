@@ -209,3 +209,138 @@ def test_rms_norm_begin_norm_axis():
     var = np.mean(np.square(xn.reshape(2, -1)), -1, keepdims=True)
     ref = (xn.reshape(2, -1) / np.sqrt(var + 1e-6)).reshape(2, 3, 4) * w.numpy()
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# varlen (packed) flash attention
+# ---------------------------------------------------------------------------
+def _cu(lens):
+    return jnp.asarray(np.concatenate([[0], np.cumsum(lens)]).astype(np.int32))
+
+
+def _varlen_ref(q, k, v, cu_q, cu_k, causal):
+    from paddle_tpu.nn.functional.attention import _xla_varlen_attention
+
+    return _xla_varlen_attention(q, k, v, cu_q, cu_k,
+                                 q.shape[-1] ** -0.5, causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_varlen_flash_matches_masked_reference(causal):
+    from paddle_tpu.ops.pallas.varlen_flash_attention import (
+        varlen_flash_attention,
+    )
+
+    rng = np.random.RandomState(0)
+    lens = [13, 37, 1, 77]   # ragged, incl. a length-1 sequence
+    cu = _cu(lens)
+    T, H, HK, D = int(cu[-1]), 4, 2, 64  # GQA group 2
+    q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(T, HK, D), jnp.float32)
+    v = jnp.asarray(rng.randn(T, HK, D), jnp.float32)
+    out = varlen_flash_attention(q, k, v, cu, cu, causal=causal,
+                                 sm_scale=D ** -0.5)
+    ref = _varlen_ref(q, k, v, cu, cu, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_varlen_flash_cross_lengths(causal):
+    """Unequal per-sequence q/kv lengths: bottom-right causal alignment."""
+    from paddle_tpu.ops.pallas.varlen_flash_attention import (
+        varlen_flash_attention,
+    )
+
+    rng = np.random.RandomState(1)
+    cu_q, cu_k = _cu([9, 25, 40]), _cu([17, 25, 61])
+    D = 64
+    q = jnp.asarray(rng.randn(int(cu_q[-1]), 4, D), jnp.float32)
+    k = jnp.asarray(rng.randn(int(cu_k[-1]), 4, D), jnp.float32)
+    v = jnp.asarray(rng.randn(int(cu_k[-1]), 4, D), jnp.float32)
+    out = varlen_flash_attention(q, k, v, cu_q, cu_k, causal=causal,
+                                 sm_scale=D ** -0.5)
+    ref = _varlen_ref(q, k, v, cu_q, cu_k, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_varlen_flash_grads_match_reference():
+    from paddle_tpu.ops.pallas.varlen_flash_attention import (
+        varlen_flash_attention,
+    )
+
+    rng = np.random.RandomState(2)
+    cu = _cu([13, 37, 1, 77])
+    T, H, HK, D = int(cu[-1]), 4, 2, 64
+    q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(T, HK, D), jnp.float32)
+    v = jnp.asarray(rng.randn(T, HK, D), jnp.float32)
+
+    def loss_pl(q, k, v):
+        return (varlen_flash_attention(
+            q, k, v, cu, cu, causal=True, sm_scale=D ** -0.5) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_varlen_ref(q, k, v, cu, cu, True) ** 2).sum()
+
+    g_pl = jax.grad(loss_pl, (0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        scale = max(1e-6, float(jnp.max(jnp.abs(b))))
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale,
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_varlen_tile_maps_skip_cross_segment_blocks():
+    """The block-skip predicates (pure function): dead tiles off, interior
+    tiles mask-free, boundary tiles masked."""
+    from paddle_tpu.ops.pallas.varlen_flash_attention import (
+        _aux_arrays, _tile_maps, _Q_PAD_SEG, _K_PAD_SEG, _REL_LO, _REL_HI,
+    )
+
+    bq = bk = 128
+    cu = _cu([256, 256])  # two 256-token sequences: 4 blocks of 128
+    seg_q, rel_q = _aux_arrays(cu, 512, _Q_PAD_SEG, _REL_LO, cu_other=cu)
+    seg_k, rel_k = _aux_arrays(cu, 512, _K_PAD_SEG, _REL_HI)
+    run, full = (np.asarray(m) for m in _tile_maps(
+        seg_q, rel_q, seg_k, rel_k, bq, bk, causal=True))
+    # blocks 0-1 = seq 0, blocks 2-3 = seq 1: cross-segment tiles dead
+    expect_run = np.array([
+        [1, 0, 0, 0],
+        [1, 1, 0, 0],
+        [0, 0, 1, 0],
+        [0, 0, 1, 1],
+    ], np.int32)
+    np.testing.assert_array_equal(run, expect_run)
+    # strictly-below-diagonal same-segment tiles are mask-free
+    assert full[1, 0] == 1 and full[3, 2] == 1
+    # diagonal tiles need the causal mask
+    assert full[0, 0] == 0 and full[1, 1] == 0
+
+
+def test_flash_attn_unpadded_dispatches_to_pallas(monkeypatch):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional.attention as attn_mod
+
+    calls = {}
+    real = attn_mod._pallas_varlen_flash
+
+    def spy(q, k, v, cq, ck, causal=False, sm_scale=None):
+        calls["hit"] = True
+        return real(q, k, v, cq, ck, causal=causal, sm_scale=sm_scale)
+
+    monkeypatch.setattr(attn_mod, "_pallas_varlen_flash", spy)
+    paddle.set_flags({"FLAGS_pallas_force": True})
+    try:
+        rng = np.random.RandomState(3)
+        cu = np.array([0, 40, 100], np.int32)
+        q = paddle.to_tensor(rng.randn(100, 4, 64).astype("float32"))
+        out, _ = attn_mod.flash_attn_unpadded(
+            q, q, q, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            64, 64, scale=64 ** -0.5, causal=True)
+        assert calls.get("hit"), "Pallas varlen path was not selected"
+        assert out.shape == [100, 4, 64]
+    finally:
+        paddle.set_flags({"FLAGS_pallas_force": False})
