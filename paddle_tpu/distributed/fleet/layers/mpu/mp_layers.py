@@ -41,9 +41,15 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
+        # The vocab-sharded gather's partial sums all-reduce to a
+        # replicated hidden state (Megatron semantics) — a replicated
+        # constraint, NOT E-over-mp: an E-sharded hidden colliding with a
+        # downstream (dp, sep)-sharded constraint makes GSPMD fall back
+        # to replicate-then-repartition (full remat). This applies to any
+        # lookup rank — the output's last dim is always embedding_dim
+        # (mp-sharded logits come from the lm matmul, never from here).
         return apply(
-            lambda v: mesh_state.constraint(v, None, None, "mp") if v.ndim == 3
-            else mesh_state.constraint(v, None, "mp"),
+            lambda v: mesh_state.constraint(v, *([None] * v.ndim)),
             out, op_name="vocab_parallel_gather",
         )
 

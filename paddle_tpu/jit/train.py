@@ -51,17 +51,49 @@ class JittedTrainStep:
         self._buffers = [b for _, b in model.named_buffers()]
         self._p_vals = [p._value for p in self._params]
         self._b_vals = [b._value for b in self._buffers]
+        if mesh_state.has_mesh():
+            # commit EVERY param/buffer to the mesh (replicated when not
+            # already placed): an uncommitted array leaves
+            # allow_spmd_sharding_propagation_to_parameters open, and the
+            # partitioner then back-propagates optimizer-state shardings
+            # into e.g. layernorm weights, poisoning the whole forward
+            # with involuntary-remat reshards
+            self._p_vals = [_commit_to_mesh(v) for v in self._p_vals]
+            self._b_vals = [_commit_to_mesh(v) for v in self._b_vals]
+            for p, v in zip(self._params, self._p_vals):
+                p._value = v
+            for b, v in zip(self._buffers, self._b_vals):
+                b._value = v
         self._s_vals = optimizer.functional_state_init(self._p_vals)
         self._decay_flags = [optimizer._decay_enabled(p) for p in self._params]
         self._step_no = 0
         self._input_batch_axes = input_batch_axes
         if state_sharding_axis and mesh_state.has_mesh():
-            self._s_vals = _shard_states(self._s_vals, state_sharding_axis)
+            self._s_vals = _shard_states(
+                self._s_vals, state_sharding_axis, self._p_vals)
 
         model_ref = model
         criterion_ref = criterion
         opt_ref = optimizer
         decay_flags = self._decay_flags
+        # Pin grads of TENSOR-PARALLEL params to the param's own layout:
+        # without it, 'sharding'-sharded moments leak their axis backward
+        # through the bwd matmuls and GSPMD full-remats params whose
+        # device order differs. Replicated params stay unpinned so their
+        # partial-sum grads can reduce-scatter straight into ZeRO-sharded
+        # moments (pinning those would force an early all-reduce).
+        from jax.sharding import NamedSharding as _NS
+
+        def _pin_sharding(v):
+            sh = getattr(v, "sharding", None)
+            if isinstance(sh, _NS) and any(s is not None for s in sh.spec):
+                return sh
+            return None
+
+        grad_pins = (
+            [_pin_sharding(v) for v in self._p_vals]
+            if mesh_state.has_mesh() else [None] * len(self._p_vals)
+        )
 
         def one_step(p_vals, s_vals, b_vals, rng, lr, step_no, inputs, labels):
             from ..core.random import traced_key_scope
@@ -82,6 +114,11 @@ class JittedTrainStep:
 
             (loss, new_b), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
+            grads = [
+                jax.lax.with_sharding_constraint(g, sh)
+                if g is not None and sh is not None else g
+                for g, sh in zip(grads, grad_pins)
+            ]
             new_p, new_s = opt_ref.functional_apply(
                 p_vals, grads, s_vals, lr, step_no, decay_flags)
             return loss, new_p, new_s, new_b
@@ -109,8 +146,26 @@ class JittedTrainStep:
             return losses, p, s, b
 
         donate_args = (0, 1, 2) if donate else ()
-        self._jitted = jax.jit(step_fn, donate_argnums=donate_args)
-        self._jitted_multi = jax.jit(multi_step_fn, donate_argnums=donate_args)
+        jit_kw = {}
+        if mesh_state.has_mesh():
+            # pin state outputs to their input placements: donation stays
+            # buffer-exact and the partitioner never "improves" the
+            # round-trip sharding (a source of involuntary remat reshards)
+            from jax.sharding import NamedSharding
+
+            def _sh(v):
+                # only mesh placements are pinnable; uncommitted arrays
+                # (SingleDeviceSharding) stay unconstrained
+                sh = getattr(v, "sharding", None)
+                return sh if isinstance(sh, NamedSharding) else None
+
+            p_sh = [_sh(v) for v in self._p_vals]
+            s_sh = jax.tree_util.tree_map(_sh, self._s_vals)
+            b_sh = [_sh(v) for v in self._b_vals]
+            jit_kw = {"out_shardings": (None, p_sh, s_sh, b_sh)}
+        self._jitted = jax.jit(step_fn, donate_argnums=donate_args, **jit_kw)
+        self._jitted_multi = jax.jit(
+            multi_step_fn, donate_argnums=donate_args, **jit_kw)
 
     def __call__(self, inputs, labels):
         """inputs/labels: Tensor or list of Tensors. Returns loss Tensor."""
@@ -184,9 +239,28 @@ class JittedTrainStep:
         return self._p_vals
 
 
-def _shard_states(states, axis):
+def _commit_to_mesh(v):
+    """Give an uncommitted array a replicated NamedSharding on the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(v, jax.Array):
+        return v
+    if isinstance(getattr(v, "sharding", None), NamedSharding):
+        return v
+    mesh = mesh_state.get_mesh()
+    spec = PartitionSpec(*([None] * v.ndim))
+    return jax.device_put(v, NamedSharding(mesh, spec))
+
+
+def _shard_states(states, axis, p_vals):
     """Place optimizer state arrays sharded over ``axis`` (dim 0 when
-    divisible) — ZeRO-1/2 optimizer-state partitioning on the mesh."""
+    divisible) — ZeRO-1/2 optimizer-state partitioning on the mesh.
+
+    Param-shaped states (moments, master weights) MERGE the param's own
+    sharding (e.g. TP's mp axis) with the ZeRO axis instead of replacing
+    it: a dim-1-mp-sharded param whose moments were dim-0-sharding-only
+    would otherwise force the partitioner into replicate-then-repartition
+    ("involuntary full rematerialization") at every optimizer update."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     mesh = mesh_state.get_mesh()
@@ -194,13 +268,49 @@ def _shard_states(states, axis):
     if size <= 1:
         return states
 
-    def place(v):
-        if not isinstance(v, jax.Array) or v.ndim == 0:
-            return v
-        if v.shape[0] % size == 0:
-            spec = PartitionSpec(axis, *([None] * (v.ndim - 1)))
-        else:
-            spec = PartitionSpec(*([None] * v.ndim))
-        return jax.device_put(v, NamedSharding(mesh, spec))
+    def _entry_size(entry):
+        if entry is None:
+            return 1
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        n = 1
+        for nm in names:
+            n *= mesh.shape[nm]
+        return n
 
-    return jax.tree_util.tree_map(place, states)
+    def _merged_spec(p, v):
+        pspec = ()
+        psh = getattr(p, "sharding", None)
+        if isinstance(psh, NamedSharding):
+            pspec = tuple(psh.spec)
+        parts = list(pspec) + [None] * (v.ndim - len(pspec))
+        d0 = parts[0]
+        existing = () if d0 is None else (
+            (d0,) if isinstance(d0, str) else tuple(d0))
+        if axis not in existing and v.shape[0] % (size * _entry_size(d0)) == 0:
+            parts[0] = (axis, *existing) if existing else axis
+        return PartitionSpec(*parts)
+
+    out = []
+    for p, st in zip(p_vals, states):
+        def place(v, p=p):
+            # 1-D params (norm scales, biases) keep replicated moments:
+            # sharding them saves ~hidden_size bytes but their unpinnable
+            # grads let the 'sharding' axis propagate backward into the
+            # activation grads (involuntary full remats). 2-D+ params
+            # carry the actual ZeRO memory win. Replicated still means
+            # COMMITTED to the mesh — an uncommitted state input would
+            # reopen the propagation hole.
+            if not isinstance(v, jax.Array) or v.ndim == 0:
+                return v
+            if v.ndim < 2:
+                return _commit_to_mesh(v)
+            if v.shape == p.shape:
+                spec = _merged_spec(p, v)
+            elif v.shape[0] % size == 0:
+                spec = PartitionSpec(axis, *([None] * (v.ndim - 1)))
+            else:
+                spec = PartitionSpec(*([None] * v.ndim))
+            return jax.device_put(v, NamedSharding(mesh, spec))
+
+        out.append(jax.tree_util.tree_map(place, st))
+    return out

@@ -102,13 +102,34 @@ def test_stage3_all_gathers_params_and_shards_memory():
 
 
 def test_stage1_state_memory_sharded():
+    """2-D+ states (the actual ZeRO memory win) shard over the axis;
+    1-D states (norm scales/biases) stay replicated by design — sharding
+    them poisons GSPMD propagation for ~hidden_size bytes of savings."""
     _sharded_mesh(8)
     _, step, _ = _build()
     seen = 0
     for st in step._s_vals:
         for k, v in st.items():
-            if isinstance(v, jax.Array) and v.ndim >= 1 and v.shape[0] % 8 == 0:
+            if not isinstance(v, jax.Array):
+                continue
+            if v.ndim >= 2 and v.shape[0] % 8 == 0:
                 local = v.addressable_shards[0].data.nbytes
                 assert local * 8 == v.nbytes, f"state {k} not sharded"
                 seen += 1
+            elif v.ndim == 1:
+                local = v.addressable_shards[0].data.nbytes
+                assert local == v.nbytes, f"1-D state {k} should replicate"
     assert seen > 0
+
+
+@pytest.mark.parametrize("stage3", [False, True])
+def test_no_involuntary_remat_reshards(capfd, stage3):
+    """Round-2 verdict weak #5: the ZeRO/TP sharding layout must compile
+    without GSPMD 'Involuntary full rematerialization' fallbacks (the
+    replicate-then-repartition bandwidth cliff). XLA logs them to fd 2."""
+    _sharded_mesh(8)
+    _, step, x = _build(stage3=stage3)
+    capfd.readouterr()  # drop anything logged so far
+    _compiled_text(step, x)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
