@@ -82,11 +82,9 @@ class JittedTrainStep:
         # device order differs. Replicated params stay unpinned so their
         # partial-sum grads can reduce-scatter straight into ZeRO-sharded
         # moments (pinning those would force an early all-reduce).
-        from jax.sharding import NamedSharding as _NS
-
         def _pin_sharding(v):
-            sh = getattr(v, "sharding", None)
-            if isinstance(sh, _NS) and any(s is not None for s in sh.spec):
+            sh = _named_sharding_of(v)
+            if sh is not None and any(s is not None for s in sh.spec):
                 return sh
             return None
 
@@ -150,18 +148,12 @@ class JittedTrainStep:
         if mesh_state.has_mesh():
             # pin state outputs to their input placements: donation stays
             # buffer-exact and the partitioner never "improves" the
-            # round-trip sharding (a source of involuntary remat reshards)
-            from jax.sharding import NamedSharding
-
-            def _sh(v):
-                # only mesh placements are pinnable; uncommitted arrays
-                # (SingleDeviceSharding) stay unconstrained
-                sh = getattr(v, "sharding", None)
-                return sh if isinstance(sh, NamedSharding) else None
-
-            p_sh = [_sh(v) for v in self._p_vals]
-            s_sh = jax.tree_util.tree_map(_sh, self._s_vals)
-            b_sh = [_sh(v) for v in self._b_vals]
+            # round-trip sharding (a source of involuntary remat reshards);
+            # only mesh placements are pinnable — uncommitted arrays
+            # (SingleDeviceSharding) stay unconstrained
+            p_sh = [_named_sharding_of(v) for v in self._p_vals]
+            s_sh = jax.tree_util.tree_map(_named_sharding_of, self._s_vals)
+            b_sh = [_named_sharding_of(v) for v in self._b_vals]
             jit_kw = {"out_shardings": (None, p_sh, s_sh, b_sh)}
         self._jitted = jax.jit(step_fn, donate_argnums=donate_args, **jit_kw)
         self._jitted_multi = jax.jit(
@@ -239,13 +231,21 @@ class JittedTrainStep:
         return self._p_vals
 
 
+def _named_sharding_of(v):
+    """The array's NamedSharding, or None when uncommitted/off-mesh."""
+    from jax.sharding import NamedSharding
+
+    sh = getattr(v, "sharding", None)
+    return sh if isinstance(sh, NamedSharding) else None
+
+
 def _commit_to_mesh(v):
     """Give an uncommitted array a replicated NamedSharding on the mesh."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     if not isinstance(v, jax.Array):
         return v
-    if isinstance(getattr(v, "sharding", None), NamedSharding):
+    if _named_sharding_of(v) is not None:
         return v
     mesh = mesh_state.get_mesh()
     spec = PartitionSpec(*([None] * v.ndim))
@@ -279,15 +279,20 @@ def _shard_states(states, axis, p_vals):
 
     def _merged_spec(p, v):
         pspec = ()
-        psh = getattr(p, "sharding", None)
-        if isinstance(psh, NamedSharding):
+        psh = _named_sharding_of(p)
+        if psh is not None:
             pspec = tuple(psh.spec)
         parts = list(pspec) + [None] * (v.ndim - len(pspec))
         d0 = parts[0]
         existing = () if d0 is None else (
             (d0,) if isinstance(d0, str) else tuple(d0))
         if axis not in existing and v.shape[0] % (size * _entry_size(d0)) == 0:
-            parts[0] = (axis, *existing) if existing else axis
+            # ZeRO axis goes MINOR (last): a PartitionSpec dim-entry tuple
+            # is major-first, so ('mp', 'sharding') subdivides each mp
+            # chunk — each device's moment shard is a sub-slice of its own
+            # param/grad shard. ('sharding', 'mp') would interleave across
+            # mp chunks and force a cross-device reshard every step.
+            parts[0] = (*existing, axis) if existing else axis
         return PartitionSpec(*parts)
 
     out = []

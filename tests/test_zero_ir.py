@@ -133,3 +133,44 @@ def test_no_involuntary_remat_reshards(capfd, stage3):
     _compiled_text(step, x)
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+
+def test_no_involuntary_remat_with_tp_and_zero(capfd):
+    """TP(mp=2) x ZeRO(sharding=4): dim-0 mp-sharded params (vocab
+    embedding) must get moments whose dim-0 spec keeps mp MAJOR and adds
+    the ZeRO axis minor — ('mp', 'sharding'), a per-device sub-slice —
+    and the whole step must compile with no involuntary remats."""
+    from paddle_tpu.nlp import (
+        LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+    )
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 1,
+        "sharding_degree": 4,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=True)
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    step = JittedTrainStep(
+        model, lambda out, labels: crit(out, labels), opt,
+        state_sharding_axis="sharding",
+    )
+    # embedding weight is ('mp', None); its moment must be (('mp','sharding'), None)
+    emb_idx = next(i for i, (n, _) in enumerate(model.named_parameters())
+                   if "embed_tokens" in n)
+    emb_p = step._p_vals[emb_idx]
+    assert tuple(emb_p.sharding.spec)[0] == "mp"
+    m_spec = tuple(step._s_vals[emb_idx]["moment1"].sharding.spec)
+    assert m_spec[0] == ("mp", "sharding"), m_spec
+
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 32)))
+    capfd.readouterr()
+    loss = float(step(ids, ids))
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+    assert np.isfinite(loss)
