@@ -318,6 +318,16 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        from ..jit import _current_guard_ctx
+
+        ctx = _current_guard_ctx()
+        if ctx is not None:
+            # SOT-lite: to_static specializes on the recorded value (or
+            # graph-breaks to learn it) instead of failing. EVERY Tensor
+            # bool routes through the context in both modes — concrete
+            # tensors too — so the eager-recorded guard tuple and the
+            # traced predicate list stay index-aligned.
+            return ctx.on_bool(self._value)
         if isinstance(self._value, jax.core.Tracer):
             raise TypeError(
                 "bool() on a traced Tensor inside jit/to_static: Python "

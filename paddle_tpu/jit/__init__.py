@@ -34,6 +34,76 @@ def enable_to_static(flag=True):
     _to_static_enabled = bool(flag)
 
 
+# --------------------------------------------------------------------------
+# SOT-lite value guards (reference: python/paddle/jit/sot/ guard-based
+# cache + graph breaks — unverified, SURVEY.md §0 / hard-part #5).
+#
+# A bool() on a traced Tensor inside to_static means value-dependent
+# Python control flow. Instead of baking one branch silently, to_static:
+#   1. breaks the graph (``_GraphBreak``), runs the call EAGERLY, and
+#      records every bool() outcome — the guard tuple;
+#   2. compiles a specialization per observed guard tuple, which ASSUMES
+#      those outcomes at trace time and returns the traced guard
+#      predicates as extra outputs;
+#   3. on later calls, runs the most-recent specialization and VERIFIES
+#      the returned predicate values against the assumptions — a
+#      mismatch discards the run and re-specializes via the eager path.
+# --------------------------------------------------------------------------
+class _GraphBreak(Exception):
+    """bool() on a traced Tensor hit an unseen value-dependent branch."""
+
+
+# distinct value specializations per (signature) cache entry before
+# giving up on compilation and running the function eagerly forever
+_MAX_GUARD_SPECS = 8
+
+
+class _GuardContext:
+    def __init__(self, mode, assumed=()):
+        self.mode = mode  # "trace" | "eager"
+        self.assumed = tuple(assumed)
+        self.outcomes = []  # eager: concrete bool() results, in order
+        self.preds = []     # trace: traced boolean scalars, in order
+        self._i = 0
+
+    def on_bool(self, value):
+        if self.mode == "eager":
+            out = bool(np.asarray(value))
+            self.outcomes.append(out)
+            return out
+        i = self._i
+        self._i += 1
+        if i < len(self.assumed):
+            # errors at trace time for non-scalar tensors, matching
+            # eager bool() semantics
+            self.preds.append(jax.numpy.reshape(value != 0, ()))
+            return self.assumed[i]
+        raise _GraphBreak()
+
+
+_active_guard_ctx = None
+
+
+def _current_guard_ctx():
+    return _active_guard_ctx
+
+
+class _guard_scope:
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        global _active_guard_ctx
+        self._prev = _active_guard_ctx
+        _active_guard_ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        global _active_guard_ctx
+        _active_guard_ctx = self._prev
+        return False
+
+
 def functional_call(layer, fn, args, kwargs, param_values, buffer_values):
     """Run ``fn`` with layer params/buffers temporarily rebound to the given
     (possibly traced) values; returns (output, new_buffer_values)."""
@@ -91,6 +161,13 @@ class StaticFunction:
         if not _to_static_enabled:
             layer, fn, call_args = self._get_layer(args)
             return fn(*call_args, **kwargs)
+        if _current_guard_ctx() is not None:
+            # nested to_static under an enclosing trace/eager-record:
+            # inline into the enclosing context so its guard machinery
+            # sees a single consistent bool() sequence (an inner jit
+            # could neither be guard-verified mid-trace nor recorded)
+            layer, fn, call_args = self._get_layer(args)
+            return fn(*call_args, **kwargs)
         layer, fn, call_args = self._get_layer(args)
 
         tensor_args = []
@@ -120,63 +197,133 @@ class StaticFunction:
             len(buffers),
         )
 
-        if static_key not in self._jit_cache:
+        entry = self._jit_cache.get(static_key)
+        if entry is None:
             layer_ref = layer
             fn_ref = fn
             spec = list(arg_spec)
             kw = dict(kwargs)
-            meta = {}  # treedef captured at first trace (static metadata)
 
-            def jittable(args_vals, param_vals, buffer_vals, rng_key):
-                from ..core.random import traced_key_scope
+            def build_spec(assumed):
+                """Compile a specialization that ASSUMES the recorded
+                bool() outcomes (SOT-lite value guards) and returns the
+                traced guard predicates for runtime verification."""
+                meta = {}
 
-                rebuilt = [
-                    Tensor(args_vals[v], stop_gradient=True) if kind == "t" else v
-                    for kind, v in spec
-                ]
-                with autograd.no_grad(), traced_key_scope(rng_key):
-                    if layer_ref is not None:
-                        out, new_buf = functional_call(
-                            layer_ref, fn_ref, rebuilt, kw, param_vals,
-                            buffer_vals,
-                        )
-                    else:
-                        out = fn_ref(*rebuilt, **kw)
-                        new_buf = []
-                flat, treedef = jax.tree_util.tree_flatten(
-                    out, is_leaf=lambda x: isinstance(x, Tensor)
-                )
-                meta["treedef"] = treedef
-                flat_vals = [
-                    t._value if isinstance(t, Tensor) else t for t in flat
-                ]
-                return flat_vals, new_buf
+                def jittable(args_vals, param_vals, buffer_vals, rng_key):
+                    from ..core.random import traced_key_scope
 
-            self._jit_cache[static_key] = (jax.jit(jittable), meta)
+                    rebuilt = [
+                        Tensor(args_vals[v], stop_gradient=True)
+                        if kind == "t" else v
+                        for kind, v in spec
+                    ]
+                    ctx = _GuardContext("trace", assumed)
+                    with _guard_scope(ctx), autograd.no_grad(), \
+                            traced_key_scope(rng_key):
+                        if layer_ref is not None:
+                            out, new_buf = functional_call(
+                                layer_ref, fn_ref, rebuilt, kw, param_vals,
+                                buffer_vals,
+                            )
+                        else:
+                            out = fn_ref(*rebuilt, **kw)
+                            new_buf = []
+                    flat, treedef = jax.tree_util.tree_flatten(
+                        out, is_leaf=lambda x: isinstance(x, Tensor)
+                    )
+                    meta["treedef"] = treedef
+                    flat_vals = [
+                        t._value if isinstance(t, Tensor) else t for t in flat
+                    ]
+                    return flat_vals, new_buf, ctx.preds
 
-        jitted, meta = self._jit_cache[static_key]
+                return jax.jit(jittable), meta
+
+            entry = {"build": build_spec, "specs": {}, "mru": ()}
+            self._jit_cache[static_key] = entry
 
         from ..core.random import next_key
 
+        # eager replays must see the TENSOR-wrapped args (raw ndarray
+        # args would dodge Tensor.__bool__, break guard recording, and
+        # change the return type)
+        eager_args = [
+            tensor_args[v] if kind == "t" else v for kind, v in arg_spec
+        ]
+
+        if entry.get("eager_only"):
+            return fn(*eager_args, **kwargs)
+
+        def run_eager_record():
+            """Graph break: run this call eagerly (correct by
+            construction), record the bool() outcomes as the guard
+            tuple, and make sure a specialization exists for it."""
+            ctx = _GuardContext("eager")
+            with _guard_scope(ctx):
+                out = fn(*eager_args, **kwargs)
+            guards = tuple(ctx.outcomes)
+            if guards not in entry["specs"]:
+                if len(entry["specs"]) >= _MAX_GUARD_SPECS:
+                    # guard-cache thrash (e.g. branching on per-batch
+                    # stats): stop compiling, stay eager permanently —
+                    # the reference SOT bounds its guard cache the same
+                    # way
+                    entry["eager_only"] = True
+                    return out
+                entry["specs"][guards] = entry["build"](guards)
+            entry["mru"] = guards
+            return out
+
+        guards = entry["mru"] if entry["mru"] in entry["specs"] else ()
+        if guards not in entry["specs"]:
+            entry["specs"][guards] = entry["build"](guards)
+        jitted, meta = entry["specs"][guards]
+
         rng_key = next_key()
         buffer_vals = [b._value for b in buffers]
+        n_preds = len(guards)
 
         def op_fn(*all_vals):
             a_vals = list(all_vals[:n_args])
             p_vals = list(all_vals[n_args : n_args + n_params])
             b_vals = list(all_vals[n_args + n_params :])
-            flat_vals, new_buf = jitted(a_vals, p_vals, b_vals, rng_key)
-            return tuple(flat_vals) + tuple(new_buf)
+            flat_vals, new_buf, preds = jitted(a_vals, p_vals, b_vals, rng_key)
+            return tuple(flat_vals) + tuple(new_buf) + tuple(preds)
 
-        results = apply(
-            op_fn, *tensor_args, *params,
-            *[Tensor(v) for v in buffer_vals],
-            op_name="to_static",
-        )
+        try:
+            results = apply(
+                op_fn, *tensor_args, *params,
+                *[Tensor(v) for v in buffer_vals],
+                op_name="to_static",
+            )
+        except _GraphBreak:
+            # value-dependent control flow hit an unseen path at trace
+            # time — re-specialize per observed value (SOT guard cache)
+            return run_eager_record()
         results = results if isinstance(results, tuple) else (results,)
         n_buf = len(buffers)
-        out_flat = list(results[: len(results) - n_buf])
-        new_buf = results[len(results) - n_buf :]
+        n_out = len(results) - n_buf - n_preds
+        out_flat = list(results[:n_out])
+        new_buf = results[n_out : n_out + n_buf]
+        pred_ts = results[n_out + n_buf :]
+        if n_preds:
+            if any(isinstance(t._value, jax.core.Tracer) for t in pred_ts):
+                raise TypeError(
+                    "a value-guarded to_static function cannot be called "
+                    "under an enclosing jax.jit trace: its guards cannot "
+                    "be verified mid-trace. Call it outside jit, or use "
+                    "paddle.static.nn.cond for the value branch."
+                )
+            observed = tuple(
+                bool(np.asarray(t._value)) for t in pred_ts
+            )
+            if observed != guards:
+                # guard check failed: discard this run (buffers not yet
+                # written back) and take the eager path, learning the
+                # new specialization for next time
+                return run_eager_record()
+            entry["mru"] = guards
         for b, nb in zip(buffers, new_buf):
             b._value = nb._value
         out = jax.tree_util.tree_unflatten(meta["treedef"], out_flat)
@@ -195,7 +342,9 @@ class StaticFunction:
         buffers = [b for _, b in layer.named_buffers()] if layer else []
         if not self._jit_cache:
             self(*args, **kwargs)
-        jitted, _ = next(iter(self._jit_cache.values()))
+        entry = next(iter(self._jit_cache.values()))
+        guards = entry["mru"] if entry["mru"] in entry["specs"] else ()
+        jitted = entry["specs"][guards][0]
         lowered = jitted.lower(
             [t._value for t in tensor_args],
             [p._value for p in params],
