@@ -326,8 +326,9 @@ class Tensor:
             # graph-breaks to learn it) instead of failing. EVERY Tensor
             # bool routes through the context in both modes — concrete
             # tensors too — so the eager-recorded guard tuple and the
-            # traced predicate list stay index-aligned.
-            return ctx.on_bool(self._value)
+            # traced predicate list stay index-aligned. ``self`` lets
+            # concrete (closed-over) guards be re-checked host-side.
+            return ctx.on_bool(self._value, owner=self)
         if isinstance(self._value, jax.core.Tracer):
             raise TypeError(
                 "bool() on a traced Tensor inside jit/to_static: Python "

@@ -189,3 +189,141 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
     if src_mask is not None:
         args.append(src_mask)
     return apply(fn, *args, op_name="masked_multihead_attention")
+
+
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder, seq_lens_decoder,
+                              seq_lens_this_time, padding_offsets=None,
+                              cum_offsets=None, cu_seqlens_q=None,
+                              cu_seqlens_k=None, block_tables=None,
+                              max_seq_len=None, block_size=None,
+                              use_neox_rotary_style=False, num_heads=None,
+                              kv_num_heads=None, head_dim=None, **kwargs):
+    """Paged/blocked KV-cache attention (reference: the 2.6-era serving op
+    paddle.incubate.nn.functional.block_multihead_attention — unverified,
+    SURVEY.md §0/§2.5).
+
+    TPU-native path: prefill rows run the varlen Pallas flash kernel over
+    the packed tokens; decode rows run the paged Pallas kernel whose
+    BlockSpec index maps dereference the per-sequence block tables in
+    SMEM (``ops/pallas/paged_attention``). K/V of the new tokens are
+    scattered into the shared block pool; ``key_cache``/``value_cache``
+    Tensors are updated in place (reference mutation semantics).
+
+    Args (core subset):
+        qkv: (total_tokens, (H + 2*HK) * D) packed projections.
+        key_cache/value_cache: (num_blocks, block_size, HK, D) pools.
+        seq_lens_encoder: (B,) prefill token counts (0 for decode rows).
+        seq_lens_decoder: (B,) tokens already in cache (decode rows).
+        seq_lens_this_time: (B,) tokens entering this call per sequence.
+        cu_seqlens_q/k: (B+1,) prefix sums of seq_lens_this_time.
+        block_tables: (B, max_blocks) int32 pool block ids.
+    Returns the attention output (total_tokens, H * D).
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from ....ops.pallas.paged_attention import paged_decode_attention
+    from ....ops.pallas.varlen_flash_attention import varlen_flash_attention
+    from ....tensor._helpers import apply
+
+    qkv = ensure_tensor(qkv)
+    key_cache = ensure_tensor(key_cache)
+    value_cache = ensure_tensor(value_cache)
+    if num_heads is None or kv_num_heads is None:
+        raise ValueError(
+            "block_multihead_attention requires num_heads/kv_num_heads "
+            "(the packed qkv layout is ambiguous without them)")
+    h, hk = int(num_heads), int(kv_num_heads)
+    bs = int(key_cache._value.shape[1])
+    if head_dim is None:
+        head_dim = qkv._value.shape[-1] // (h + 2 * hk)
+    d = int(head_dim)
+
+    this_time = np.asarray(ensure_tensor(seq_lens_this_time)._value)
+    dec_lens = np.asarray(ensure_tensor(seq_lens_decoder)._value)
+    tables = ensure_tensor(block_tables)._value
+    total = int(this_time.sum())
+    b = len(this_time)
+
+    def split_qkv(v):
+        q = v[:, : h * d].reshape(-1, h, d)
+        k = v[:, h * d : (h + hk) * d].reshape(-1, hk, d)
+        val = v[:, (h + hk) * d :].reshape(-1, hk, d)
+        return q, k, val
+
+    # Row routing (host-side: lens are serving metadata, concrete in the
+    # eager serving loop): decode rows contribute one token; prefill rows
+    # (including CHUNKED prefill continuing a cached context) contribute
+    # this_time tokens and attend over cache + new via the varlen kernel's
+    # bottom-right causal alignment.
+    enc_lens = np.asarray(ensure_tensor(seq_lens_encoder)._value)
+    is_prefill_row = (this_time > 1) | (enc_lens > 0)
+    cu_all = np.concatenate([[0], np.cumsum(this_time)]).astype(np.int32)
+    tbl_np = np.asarray(tables)
+
+    # every new token's pool slot (both modes write the same way)
+    seq_of_tok = np.repeat(np.arange(b), this_time).astype(np.int32)
+    pos_in_seq = (np.arange(total) - cu_all[seq_of_tok]).astype(np.int32)
+    abs_pos = (dec_lens[seq_of_tok] + pos_in_seq).astype(np.int32)
+    blk_ids = jnp.asarray(
+        tbl_np[seq_of_tok, abs_pos // bs].astype(np.int32))
+    offs = jnp.asarray((abs_pos % bs).astype(np.int32))
+
+    pre_rows = np.nonzero(is_prefill_row)[0]
+    dec_rows = np.nonzero(~is_prefill_row)[0]
+    # token indices of each group, in packed order
+    pre_tok = np.concatenate(
+        [np.arange(cu_all[i], cu_all[i + 1]) for i in pre_rows]
+    ).astype(np.int32) if len(pre_rows) else np.zeros(0, np.int32)
+    dec_tok = cu_all[dec_rows].astype(np.int32)  # one token per row
+
+    # prefill attention context: cached tokens (gathered from the pool)
+    # followed by this call's new tokens, per row
+    ctx_lens = (dec_lens[pre_rows] + this_time[pre_rows]).astype(np.int32)
+    cu_q_pre = np.concatenate(
+        [[0], np.cumsum(this_time[pre_rows])]).astype(np.int32)
+    cu_k_pre = np.concatenate([[0], np.cumsum(ctx_lens)]).astype(np.int32)
+    ctx_seq = np.repeat(pre_rows, ctx_lens).astype(np.int32)
+    ctx_pos = (np.arange(int(ctx_lens.sum()), dtype=np.int32)
+               - cu_k_pre[np.repeat(np.arange(len(pre_rows)), ctx_lens)])
+    ctx_blk = jnp.asarray(
+        tbl_np[ctx_seq, ctx_pos // bs].astype(np.int32)) \
+        if len(pre_rows) else None
+    ctx_off = jnp.asarray((ctx_pos % bs).astype(np.int32)) \
+        if len(pre_rows) else None
+
+    dec_positions = jnp.asarray(dec_lens[dec_rows], jnp.int32)
+    dec_tbl = jnp.asarray(tbl_np[dec_rows]) if len(dec_rows) else None
+
+    def fn(qkv_v, kp, vp):
+        q, k_new, v_new = split_qkv(qkv_v)
+        kp2 = kp.at[blk_ids, offs].set(k_new.astype(kp.dtype))
+        vp2 = vp.at[blk_ids, offs].set(v_new.astype(vp.dtype))
+        out = jnp.zeros((total, h, d), q.dtype)
+        if len(pre_rows):
+            q_pre = q[jnp.asarray(pre_tok)]
+            # gather each prefill row's full context (cache + new) from
+            # the updated pool
+            k_ctx = kp2[ctx_blk, ctx_off].astype(q.dtype)
+            v_ctx = vp2[ctx_blk, ctx_off].astype(q.dtype)
+            o_pre = varlen_flash_attention(
+                q_pre, k_ctx, v_ctx, jnp.asarray(cu_q_pre),
+                jnp.asarray(cu_k_pre), causal=True)
+            out = out.at[jnp.asarray(pre_tok)].set(o_pre)
+        if len(dec_rows):
+            o_dec = paged_decode_attention(
+                q[jnp.asarray(dec_tok)], kp2, vp2, dec_tbl,
+                dec_positions + 1)
+            out = out.at[jnp.asarray(dec_tok)].set(o_dec)
+        return out.reshape(total, h * d), kp2, vp2
+
+    out, new_k, new_v = apply(
+        fn, qkv, key_cache, value_cache,
+        op_name="block_multihead_attention",
+    )
+    key_cache._value = new_k._value
+    value_cache._value = new_v._value
+    return out
+
+
+__all__.append("block_multihead_attention")

@@ -64,28 +64,46 @@ class _GuardContext:
         self.assumed = tuple(assumed)
         self.outcomes = []  # eager: concrete bool() results, in order
         self.preds = []     # trace: traced boolean scalars, in order
+        self.pred_expect = []  # trace: assumed outcome per traced pred
+        # trace: (weakref(owner Tensor), expected bool) for CONCRETE
+        # guards — closed-over tensors are trace-time constants, so their
+        # predicates cannot be verified in the compiled program; they are
+        # re-checked host-side before each cached-spec run instead
+        self.host_checks = []
         self._i = 0
 
-    def on_bool(self, value):
+    def on_bool(self, value, owner=None):
         if self.mode == "eager":
             out = bool(np.asarray(value))
             self.outcomes.append(out)
             return out
         i = self._i
         self._i += 1
-        if i < len(self.assumed):
+        if i >= len(self.assumed):
+            raise _GraphBreak()
+        if isinstance(value, jax.core.Tracer):
             # errors at trace time for non-scalar tensors, matching
             # eager bool() semantics
             self.preds.append(jax.numpy.reshape(value != 0, ()))
+            self.pred_expect.append(self.assumed[i])
             return self.assumed[i]
-        raise _GraphBreak()
+        actual = bool(np.asarray(value))
+        if actual != self.assumed[i]:
+            raise _GraphBreak()  # constant changed between record & trace
+        import weakref
+
+        self.host_checks.append(
+            (weakref.ref(owner) if owner is not None else None, actual))
+        return actual
 
 
-_active_guard_ctx = None
+import threading as _threading
+
+_guard_tls = _threading.local()
 
 
 def _current_guard_ctx():
-    return _active_guard_ctx
+    return getattr(_guard_tls, "ctx", None)
 
 
 class _guard_scope:
@@ -93,14 +111,12 @@ class _guard_scope:
         self._ctx = ctx
 
     def __enter__(self):
-        global _active_guard_ctx
-        self._prev = _active_guard_ctx
-        _active_guard_ctx = self._ctx
+        self._prev = getattr(_guard_tls, "ctx", None)
+        _guard_tls.ctx = self._ctx
         return self._ctx
 
     def __exit__(self, *exc):
-        global _active_guard_ctx
-        _active_guard_ctx = self._prev
+        _guard_tls.ctx = self._prev
         return False
 
 
@@ -233,6 +249,9 @@ class StaticFunction:
                         out, is_leaf=lambda x: isinstance(x, Tensor)
                     )
                     meta["treedef"] = treedef
+                    meta["n_preds"] = len(ctx.preds)
+                    meta["pred_expect"] = tuple(ctx.pred_expect)
+                    meta["host_checks"] = ctx.host_checks
                     flat_vals = [
                         t._value if isinstance(t, Tensor) else t for t in flat
                     ]
@@ -264,7 +283,8 @@ class StaticFunction:
                 out = fn(*eager_args, **kwargs)
             guards = tuple(ctx.outcomes)
             if guards not in entry["specs"]:
-                if len(entry["specs"]) >= _MAX_GUARD_SPECS:
+                n_value_specs = sum(1 for g in entry["specs"] if g != ())
+                if n_value_specs >= _MAX_GUARD_SPECS:
                     # guard-cache thrash (e.g. branching on per-batch
                     # stats): stop compiling, stay eager permanently —
                     # the reference SOT bounds its guard cache the same
@@ -280,9 +300,16 @@ class StaticFunction:
             entry["specs"][guards] = entry["build"](guards)
         jitted, meta = entry["specs"][guards]
 
+        # concrete (closed-over) guards are trace-time constants — verify
+        # them host-side BEFORE serving the cached spec; a dead weakref
+        # or changed value re-routes through the eager path
+        for ref_, expect in meta.get("host_checks", []):
+            t = ref_() if ref_ is not None else None
+            if t is None or bool(np.asarray(t._value)) != expect:
+                return run_eager_record()
+
         rng_key = next_key()
         buffer_vals = [b._value for b in buffers]
-        n_preds = len(guards)
 
         def op_fn(*all_vals):
             a_vals = list(all_vals[:n_args])
@@ -303,6 +330,8 @@ class StaticFunction:
             return run_eager_record()
         results = results if isinstance(results, tuple) else (results,)
         n_buf = len(buffers)
+        # populated by the trace (which has run by now — apply executed)
+        n_preds = meta["n_preds"]
         n_out = len(results) - n_buf - n_preds
         out_flat = list(results[:n_out])
         new_buf = results[n_out : n_out + n_buf]
@@ -318,11 +347,12 @@ class StaticFunction:
             observed = tuple(
                 bool(np.asarray(t._value)) for t in pred_ts
             )
-            if observed != guards:
+            if observed != meta["pred_expect"]:
                 # guard check failed: discard this run (buffers not yet
                 # written back) and take the eager path, learning the
                 # new specialization for next time
                 return run_eager_record()
+        if guards:
             entry["mru"] = guards
         for b, nb in zip(buffers, new_buf):
             b._value = nb._value

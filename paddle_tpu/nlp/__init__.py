@@ -21,3 +21,4 @@ from .bert import (  # noqa: F401
     ErnieConfig, ErnieModel, ErnieForPretraining,
 )
 from .t5 import T5Config, T5Model, T5ForConditionalGeneration  # noqa: F401
+from .paged_cache import PagedKVCachePool  # noqa: F401

@@ -1,0 +1,92 @@
+"""Paged KV-cache pool manager (host-side block allocator).
+
+The reference's blocked serving cache (paddle/incubate/nn/functional/
+block_multihead_attention + PaddleNLP's BlockInferencePredictor —
+unverified, SURVEY.md §0/§2.5) allocates fixed-size KV blocks from a
+shared pool so HBM scales with LIVE tokens, not batch × max_seq_len.
+The allocator is plain host Python (a free list); the device side is the
+pool arrays + int32 block tables consumed by
+``ops/pallas/paged_attention``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCachePool"]
+
+
+class PagedKVCachePool:
+    """A shared K/V block pool + per-sequence block tables.
+
+    Args:
+        num_blocks: pool capacity in blocks (shared by all sequences).
+        block_size: tokens per block (lane-friendly: 16/32/64...).
+        num_kv_heads, head_dim, num_layers: cache geometry.
+        dtype: cache dtype (bf16 for serving).
+    """
+
+    def __init__(self, num_blocks, block_size, num_kv_heads, head_dim,
+                 num_layers=1, dtype=jnp.bfloat16):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.num_layers = int(num_layers)
+        shape = (self.num_blocks, self.block_size, self.num_kv_heads,
+                 self.head_dim)
+        self.k_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._tables: dict = {}   # seq_id -> list[int] block ids
+        self._lens: dict = {}     # seq_id -> int tokens
+
+    # -- allocator ---------------------------------------------------------
+    def ensure(self, seq_id, new_total_tokens):
+        """Grow ``seq_id``'s block table to cover ``new_total_tokens``."""
+        table = self._tables.setdefault(seq_id, [])
+        need = -(-int(new_total_tokens) // self.block_size)
+        while len(table) < need:
+            if not self._free:
+                raise RuntimeError(
+                    f"KV pool exhausted ({self.num_blocks} blocks)")
+            table.append(self._free.pop())
+        self._lens[seq_id] = int(new_total_tokens)
+        return table
+
+    def free(self, seq_id):
+        """Return a finished sequence's blocks to the pool."""
+        for blk in self._tables.pop(seq_id, []):
+            self._free.append(blk)
+        self._lens.pop(seq_id, None)
+
+    def seq_len(self, seq_id):
+        return self._lens.get(seq_id, 0)
+
+    @property
+    def blocks_in_use(self):
+        return self.num_blocks - len(self._free)
+
+    def bytes_in_use(self):
+        """Live cache bytes — the paged-cache memory claim: scales with
+        allocated blocks, not batch × max_seq."""
+        per_block = (self.block_size * self.num_kv_heads * self.head_dim
+                     * self.k_pools[0].dtype.itemsize)
+        return 2 * self.num_layers * self.blocks_in_use * per_block
+
+    # -- device views ------------------------------------------------------
+    def block_table_array(self, seq_ids, pad_to=None):
+        """(B, max_blocks) int32 table for the given sequences (dead
+        entries = 0; they are predicated off by seq_lens)."""
+        tables = [self._tables.get(s, []) for s in seq_ids]
+        width = max([len(t) for t in tables] + [1])
+        if pad_to:
+            width = max(width, pad_to)
+        out = np.zeros((len(seq_ids), width), np.int32)
+        for i, t in enumerate(tables):
+            out[i, : len(t)] = t
+        return jnp.asarray(out)
+
+    def seq_lens_array(self, seq_ids):
+        return jnp.asarray([self._lens.get(s, 0) for s in seq_ids],
+                           jnp.int32)

@@ -2,3 +2,4 @@ from .flash_attention import flash_attention  # noqa: F401
 from .rms_norm import rms_norm  # noqa: F401
 from .decode_attention import decode_attention  # noqa: F401
 from .varlen_flash_attention import varlen_flash_attention  # noqa: F401
+from .paged_attention import paged_decode_attention, paged_cache_write  # noqa: F401

@@ -1,0 +1,271 @@
+"""Paged/blocked KV-cache decode (reference: the 2.6-era serving op
+block_multihead_attention + block pool — unverified, SURVEY.md §0/§2.5):
+parity vs the contiguous-cache decode kernel, pool allocator semantics,
+and the memory-scales-with-live-tokens claim."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.decode_attention import decode_attention
+from paddle_tpu.ops.pallas.paged_attention import (
+    paged_decode_attention, paged_cache_write,
+)
+from paddle_tpu.nlp import PagedKVCachePool
+
+
+def _ragged_setup(rng, lens, h=8, hk=4, d=64, bs=32):
+    """Build a contiguous cache and an equivalent paged pool."""
+    b = len(lens)
+    s_max = max(lens)
+    kc = rng.randn(b, s_max, hk, d).astype("f4")
+    vc = rng.randn(b, s_max, hk, d).astype("f4")
+    for i, ln in enumerate(lens):  # zero the invalid tail for clarity
+        kc[i, ln:] = 0
+        vc[i, ln:] = 0
+    pool = PagedKVCachePool(num_blocks=64, block_size=bs, num_kv_heads=hk,
+                            head_dim=d, dtype=jnp.float32)
+    kp = np.zeros((64, bs, hk, d), "f4")
+    vp = np.zeros((64, bs, hk, d), "f4")
+    for i, ln in enumerate(lens):
+        table = pool.ensure(i, ln)
+        for pos in range(ln):
+            kp[table[pos // bs], pos % bs] = kc[i, pos]
+            vp[table[pos // bs], pos % bs] = vc[i, pos]
+    tables = pool.block_table_array(range(b))
+    seq_lens = pool.seq_lens_array(range(b))
+    return kc, vc, jnp.asarray(kp), jnp.asarray(vp), tables, seq_lens
+
+
+def test_paged_matches_contiguous_decode():
+    rng = np.random.RandomState(0)
+    lens = [7, 32, 57, 128]
+    h, hk, d = 8, 4, 64
+    kc, vc, kp, vp, tables, seq_lens = _ragged_setup(rng, lens, h, hk, d)
+    q = jnp.asarray(rng.randn(len(lens), h, d), jnp.float32)
+    ref = decode_attention(q, jnp.asarray(kc), jnp.asarray(vc),
+                           jnp.asarray(lens, jnp.int32))
+    out = paged_decode_attention(q, kp, vp, tables, seq_lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_cache_write_then_attend():
+    rng = np.random.RandomState(1)
+    lens = [15, 40]
+    h, hk, d, bs = 4, 2, 64, 32
+    kc, vc, kp, vp, tables, seq_lens = _ragged_setup(
+        rng, lens, h, hk, d, bs)
+    pool = PagedKVCachePool(num_blocks=8, block_size=bs, num_kv_heads=hk,
+                            head_dim=d, dtype=jnp.float32)
+    # decode one more token per sequence
+    k_new = jnp.asarray(rng.randn(2, hk, d), jnp.float32)
+    v_new = jnp.asarray(rng.randn(2, hk, d), jnp.float32)
+    positions = jnp.asarray(lens, jnp.int32)
+    kp2, vp2 = paged_cache_write(kp, vp, k_new, v_new, tables, positions)
+    q = jnp.asarray(rng.randn(2, h, d), jnp.float32)
+    out = paged_decode_attention(q, kp2, vp2, tables,
+                                 positions + 1)
+    # contiguous reference with the token appended
+    kc2 = np.zeros((2, max(lens) + 1, hk, d), "f4")
+    vc2 = np.zeros_like(kc2)
+    kc2[:, : max(lens)] = kc
+    vc2[:, : max(lens)] = vc
+    for i, ln in enumerate(lens):
+        kc2[i, ln] = np.asarray(k_new[i])
+        vc2[i, ln] = np.asarray(v_new[i])
+    ref = decode_attention(q, jnp.asarray(kc2), jnp.asarray(vc2),
+                           jnp.asarray([l + 1 for l in lens], jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pool_allocator_reuse_and_memory_claim():
+    pool = PagedKVCachePool(num_blocks=16, block_size=32, num_kv_heads=2,
+                            head_dim=64, num_layers=2)
+    pool.ensure("a", 100)   # 4 blocks
+    pool.ensure("b", 10)    # 1 block
+    assert pool.blocks_in_use == 5
+    per_block = 32 * 2 * 64 * 2  # tokens*heads*dim*bf16
+    assert pool.bytes_in_use() == 2 * 2 * 5 * per_block
+    pool.free("a")
+    assert pool.blocks_in_use == 1
+    pool.ensure("c", 128)   # reuses a's blocks
+    assert pool.blocks_in_use == 5
+    # exhaustion raises
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.ensure("d", 16 * 32)
+
+
+def test_block_multihead_attention_prefill_then_decode():
+    """The incubate functional: prefill writes the pool + varlen flash;
+    decode steps match a full-context reference."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+    from paddle_tpu.nn.functional.attention import _xla_varlen_attention
+
+    rng = np.random.RandomState(2)
+    h, hk, d, bs = 4, 2, 64, 32
+    lens = [9, 21]
+    b = len(lens)
+    total = sum(lens)
+    pool = PagedKVCachePool(num_blocks=16, block_size=bs, num_kv_heads=hk,
+                            head_dim=d, dtype=jnp.float32)
+    for i, ln in enumerate(lens):
+        pool.ensure(i, ln)
+    kcache = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+    vcache = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+
+    qkv_np = rng.randn(total, (h + 2 * hk) * d).astype("f4")
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    out = block_multihead_attention(
+        paddle.to_tensor(qkv_np), kcache, vcache,
+        seq_lens_encoder=paddle.to_tensor(np.asarray(lens, "i4")),
+        seq_lens_decoder=paddle.to_tensor(np.zeros(b, "i4")),
+        seq_lens_this_time=paddle.to_tensor(np.asarray(lens, "i4")),
+        cu_seqlens_q=paddle.to_tensor(cu), cu_seqlens_k=paddle.to_tensor(cu),
+        block_tables=paddle.to_tensor(
+            np.asarray(pool.block_table_array(range(b)))),
+        num_heads=h, kv_num_heads=hk,
+    )
+    # reference prefill: causal varlen attention over the same packed qkv
+    q = qkv_np[:, : h * d].reshape(total, h, d)
+    k = qkv_np[:, h * d : (h + hk) * d].reshape(total, hk, d)
+    v = qkv_np[:, (h + hk) * d :].reshape(total, hk, d)
+    ref = _xla_varlen_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(cu), jnp.asarray(cu), d ** -0.5, True)
+    np.testing.assert_allclose(
+        np.asarray(out._value).reshape(total, h, d), np.asarray(ref),
+        rtol=2e-5, atol=2e-5)
+
+    # decode one token per sequence; reference = full-context attention
+    for i in range(b):
+        pool.ensure(i, lens[i] + 1)
+    qkv_dec = rng.randn(b, (h + 2 * hk) * d).astype("f4")
+    out_dec = block_multihead_attention(
+        paddle.to_tensor(qkv_dec), kcache, vcache,
+        seq_lens_encoder=paddle.to_tensor(np.zeros(b, "i4")),
+        seq_lens_decoder=paddle.to_tensor(np.asarray(lens, "i4")),
+        seq_lens_this_time=paddle.to_tensor(np.ones(b, "i4")),
+        block_tables=paddle.to_tensor(
+            np.asarray(pool.block_table_array(range(b)))),
+        num_heads=h, kv_num_heads=hk,
+    )
+    qd = qkv_dec[:, : h * d].reshape(b, h, d)
+    kd = qkv_dec[:, h * d : (h + hk) * d].reshape(b, hk, d)
+    vd = qkv_dec[:, (h + hk) * d :].reshape(b, hk, d)
+    kc_full = np.zeros((b, max(lens) + 1, hk, d), "f4")
+    vc_full = np.zeros_like(kc_full)
+    for i, ln in enumerate(lens):
+        kc_full[i, :ln] = k[cu[i]:cu[i + 1]]
+        vc_full[i, :ln] = v[cu[i]:cu[i + 1]]
+        kc_full[i, ln] = kd[i]
+        vc_full[i, ln] = vd[i]
+    ref_dec = decode_attention(
+        jnp.asarray(qd), jnp.asarray(kc_full), jnp.asarray(vc_full),
+        jnp.asarray([l + 1 for l in lens], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(out_dec._value).reshape(b, h, d), np.asarray(ref_dec),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_block_mha_mixed_prefill_decode_batch():
+    """Round-3 review finding: mixed batches must route per row — the
+    decode row attends over its cached context, the prefill row over its
+    own new tokens."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+    from paddle_tpu.nn.functional.attention import _xla_varlen_attention
+
+    rng = np.random.RandomState(3)
+    h, hk, d, bs = 4, 2, 64, 32
+    pool = PagedKVCachePool(num_blocks=16, block_size=bs, num_kv_heads=hk,
+                            head_dim=d, dtype=jnp.float32)
+    # row 1 already holds 16 cached tokens
+    cached = rng.randn(16, hk, d).astype("f4") * 0.5
+    cached_v = rng.randn(16, hk, d).astype("f4") * 0.5
+    pool.ensure(1, 16)
+    kcache_np = np.zeros((16, bs, hk, d), "f4")
+    vcache_np = np.zeros_like(kcache_np)
+    t1 = pool._tables[1]
+    for pos in range(16):
+        kcache_np[t1[pos // bs], pos % bs] = cached[pos]
+        vcache_np[t1[pos // bs], pos % bs] = cached_v[pos]
+    pool.ensure(0, 8)    # row 0: fresh prefill of 8 tokens
+    pool.ensure(1, 17)   # row 1: decode 1 token
+    kcache = paddle.to_tensor(kcache_np)
+    vcache = paddle.to_tensor(vcache_np)
+
+    qkv_np = rng.randn(9, (h + 2 * hk) * d).astype("f4")  # 8 + 1 tokens
+    out = block_multihead_attention(
+        paddle.to_tensor(qkv_np), kcache, vcache,
+        seq_lens_encoder=paddle.to_tensor(np.asarray([8, 0], "i4")),
+        seq_lens_decoder=paddle.to_tensor(np.asarray([0, 16], "i4")),
+        seq_lens_this_time=paddle.to_tensor(np.asarray([8, 1], "i4")),
+        block_tables=paddle.to_tensor(
+            np.asarray(pool.block_table_array(range(2)))),
+        num_heads=h, kv_num_heads=hk,
+    ).numpy().reshape(9, h, d)
+
+    q = qkv_np[:, : h * d].reshape(9, h, d)
+    k = qkv_np[:, h * d : (h + hk) * d].reshape(9, hk, d)
+    v = qkv_np[:, (h + hk) * d :].reshape(9, hk, d)
+    # row 0 reference: causal self-attention over its 8 tokens
+    ref0 = _xla_varlen_attention(
+        jnp.asarray(q[:8]), jnp.asarray(k[:8]), jnp.asarray(v[:8]),
+        jnp.asarray([0, 8], jnp.int32), jnp.asarray([0, 8], jnp.int32),
+        d ** -0.5, True)
+    np.testing.assert_allclose(out[:8], np.asarray(ref0), rtol=2e-5, atol=2e-5)
+    # row 1 reference: decode over cached 16 + the new token
+    kc_full = np.concatenate([cached, k[8:9]], 0)[None]
+    vc_full = np.concatenate([cached_v, v[8:9]], 0)[None]
+    ref1 = decode_attention(jnp.asarray(q[8:9]), jnp.asarray(kc_full),
+                            jnp.asarray(vc_full),
+                            jnp.asarray([17], jnp.int32))
+    np.testing.assert_allclose(out[8:9], np.asarray(ref1), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_block_mha_chunked_prefill_attends_cache():
+    """A prefill row with dec_lens>0 (chunked prefill) must attend over
+    the cached context too, bottom-right aligned."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+    from paddle_tpu.nn.functional.attention import _xla_varlen_attention
+
+    rng = np.random.RandomState(4)
+    h, hk, d, bs = 4, 2, 64, 32
+    pool = PagedKVCachePool(num_blocks=8, block_size=bs, num_kv_heads=hk,
+                            head_dim=d, dtype=jnp.float32)
+    cached_k = rng.randn(10, hk, d).astype("f4") * 0.5
+    cached_v = rng.randn(10, hk, d).astype("f4") * 0.5
+    pool.ensure(0, 10)
+    kcache_np = np.zeros((8, bs, hk, d), "f4")
+    vcache_np = np.zeros_like(kcache_np)
+    t0 = pool._tables[0]
+    for pos in range(10):
+        kcache_np[t0[pos // bs], pos % bs] = cached_k[pos]
+        vcache_np[t0[pos // bs], pos % bs] = cached_v[pos]
+    pool.ensure(0, 16)  # 6 more tokens arriving now
+    kcache, vcache = paddle.to_tensor(kcache_np), paddle.to_tensor(vcache_np)
+
+    qkv_np = rng.randn(6, (h + 2 * hk) * d).astype("f4")
+    out = block_multihead_attention(
+        paddle.to_tensor(qkv_np), kcache, vcache,
+        seq_lens_encoder=paddle.to_tensor(np.asarray([6], "i4")),
+        seq_lens_decoder=paddle.to_tensor(np.asarray([10], "i4")),
+        seq_lens_this_time=paddle.to_tensor(np.asarray([6], "i4")),
+        block_tables=paddle.to_tensor(
+            np.asarray(pool.block_table_array([0]))),
+        num_heads=h, kv_num_heads=hk,
+    ).numpy().reshape(6, h, d)
+
+    q = qkv_np[:, : h * d].reshape(6, h, d)
+    k = qkv_np[:, h * d : (h + hk) * d].reshape(6, hk, d)
+    v = qkv_np[:, (h + hk) * d :].reshape(6, hk, d)
+    k_full = np.concatenate([cached_k, k], 0)
+    v_full = np.concatenate([cached_v, v], 0)
+    ref = _xla_varlen_attention(
+        jnp.asarray(q), jnp.asarray(k_full), jnp.asarray(v_full),
+        jnp.asarray([0, 6], jnp.int32), jnp.asarray([0, 16], jnp.int32),
+        d ** -0.5, True)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)

@@ -206,3 +206,24 @@ def test_guard_cache_bounded_falls_back_to_eager():
         np.testing.assert_allclose(out, ref, rtol=1e-6)
         entry = next(iter(f._jit_cache.values()))
     assert len(entry["specs"]) <= _MAX_GUARD_SPECS + 1
+
+
+def test_stale_concrete_guard_recheck():
+    """A closed-over CONCRETE tensor guard is a trace-time constant; the
+    host-side re-check must notice mutation and reroute (round-3 review
+    finding — previously served the stale branch forever)."""
+    flag = paddle.to_tensor(np.asarray(1.0, "f4"))
+
+    @paddle.jit.to_static
+    def f(x):
+        if flag:
+            return x + 100.0
+        return x - 100.0
+
+    x = paddle.to_tensor(np.zeros(2, "f4"))
+    np.testing.assert_allclose(np.asarray(f(x)._value), np.full(2, 100.0))
+    np.testing.assert_allclose(np.asarray(f(x)._value), np.full(2, 100.0))
+    flag._value = flag._value * 0.0  # mutate the closed-over tensor
+    np.testing.assert_allclose(np.asarray(f(x)._value), np.full(2, -100.0))
+    flag._value = flag._value + 1.0
+    np.testing.assert_allclose(np.asarray(f(x)._value), np.full(2, 100.0))
