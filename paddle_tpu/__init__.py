@@ -6,6 +6,20 @@ mirror ``paddle.*`` (see SURVEY.md for the reference component map).
 """
 from __future__ import annotations
 
+import os as _os
+
+# Multi-controller bootstrap MUST precede any backend use (jax.devices,
+# device_put, ...), and importing the framework touches the backend —
+# so a launched worker rendezvouses here, at import. The PJRT
+# coordination service replaces the reference's TCPStore (SURVEY.md
+# §2.3 TCPStore row — unverified). Gated on the launcher-private marker:
+# subprocesses that merely INHERIT the public PADDLE_* vars must not try
+# to join the rendezvous as a duplicate process.
+if _os.environ.get("PADDLE_TPU_LAUNCHED") == "1":
+    from ._bootstrap import rendezvous_from_env as _rdv
+
+    _rdv()
+
 from .version import __version__
 
 # core

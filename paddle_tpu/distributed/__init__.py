@@ -85,15 +85,13 @@ def init_parallel_env():
     """
     if parallel_env._initialized:
         return parallel_env
-    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if n > 1 and jax.process_count() == 1:
-        coordinator = os.environ.get("PADDLE_MASTER") or os.environ.get(
-            "MASTER_ADDR", "127.0.0.1:8701"
-        )
-        pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        jax.distributed.initialize(
-            coordinator_address=coordinator, num_processes=n, process_id=pid
-        )
+    # normally already rendezvoused at `import paddle_tpu` (the backend
+    # must not be touched first); this covers direct embedders that set
+    # the env protocol themselves after import
+    from .._bootstrap import rendezvous_from_env
+
+    if jax.process_count() == 1:
+        rendezvous_from_env()
     parallel_env._initialized = True
     return parallel_env
 
@@ -147,17 +145,132 @@ def _maybe_task(result, sync_op):
     return result if sync_op else Task(result)
 
 
+def _world_mesh_one_dev_per_proc():
+    """A 1-D mesh with exactly one device per PROCESS — the substrate for
+    genuinely cross-process eager collectives (multi-controller: every
+    process runs the same program over this shared mesh)."""
+    from jax.sharding import Mesh
+
+    per = {}
+    for d in jax.devices():
+        per.setdefault(d.process_index, d)
+    devs = [per[i] for i in sorted(per)]
+    return Mesh(np.array(devs), ("world",))
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=256)
+def _collective_fn(op_name, shape, dtype_str, n):
+    """Compiled cross-process reduction, cached per (op, shape, dtype) —
+    eager collectives in a training loop must not retrace every call."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+    from jax.experimental.shard_map import shard_map
+
+    mesh = _world_mesh_one_dev_per_proc()
+
+    def prod(x):
+        # sign-tracking product: exp(psum(log|x|)) * (-1)^(neg count) —
+        # a plain log would NaN on negative elements
+        mag = jnp.exp(jax.lax.psum(
+            jnp.log(jnp.maximum(jnp.abs(x.astype(jnp.float32)), 1e-38)),
+            "world"))
+        negs = jax.lax.psum((x < 0).astype(jnp.int32), "world")
+        zeros = jax.lax.psum((x == 0).astype(jnp.int32), "world")
+        signed = jnp.where(negs % 2 == 1, -mag, mag)
+        return jnp.where(zeros > 0, 0.0, signed).astype(x.dtype)
+
+    red = {
+        "sum": lambda x: jax.lax.psum(x, "world"),
+        "avg": lambda x: jax.lax.psum(x, "world") / n,
+        "max": lambda x: jax.lax.pmax(x, "world"),
+        "min": lambda x: jax.lax.pmin(x, "world"),
+        "prod": prod,
+        # gather as one-hot scatter + psum: psum's replication is
+        # statically inferable by shard_map (lax.all_gather's is not)
+        "gather": lambda x: jax.lax.psum(
+            jnp.zeros((n, *x.shape[1:]), x.dtype)
+            .at[jax.lax.axis_index("world")].set(x[0]),
+            "world",
+        ),
+    }[op_name]
+    fn = shard_map(
+        lambda x: red(x)[0] if op_name != "gather" else red(x),
+        mesh=mesh, in_specs=PartitionSpec("world"),
+        out_specs=PartitionSpec(),
+    )
+    return jax.jit(fn), mesh
+
+
+def _cross_process_collective(value, op_name):
+    """Reduce the local value across processes; returns a local array.
+    Each process contributes one shard of a (world, ...) global array;
+    shard_map reduces over the world axis."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    value = jnp.asarray(value)
+    n_proc = len({d.process_index for d in jax.devices()})
+    fn, mesh = _collective_fn(
+        op_name, tuple(value.shape), str(value.dtype), n_proc)
+    my_dev = mesh.devices.flat[jax.process_index()]
+    local = jax.device_put(value[None], my_dev)
+    garr = jax.make_array_from_single_device_arrays(
+        (mesh.devices.size, *value.shape),
+        NamedSharding(mesh, PartitionSpec("world")), [local],
+    )
+    out = fn(garr)
+    # fully replicated over the mesh → the local copy is the answer
+    return jnp.asarray(np.asarray(out))
+
+
+def _op_name(op):
+    names = {
+        ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+        ReduceOp.MIN: "min", ReduceOp.PROD: "prod",
+    }
+    if hasattr(ReduceOp, "AVG"):
+        names[ReduceOp.AVG] = "avg"
+    if op not in names:
+        raise ValueError(f"unsupported ReduceOp for multi-process: {op!r}")
+    return names[op]
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """On replicated/global data this is the identity (the value already
-    includes every shard's contribution under GSPMD); kept for API parity."""
+    """Single-controller (the common TPU pattern): identity — replicated
+    or global data already includes every shard's contribution under
+    GSPMD. Multi-controller (launch CLI, one process per host): a real
+    cross-process reduction over the PJRT coordination service."""
+    if jax.process_count() > 1:
+        t = _ensure_tensor(tensor)
+        t._value = _cross_process_collective(t._value, _op_name(op))
+        return _maybe_task(t, sync_op)
     return _maybe_task(tensor, sync_op)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    if jax.process_count() > 1:
+        t = _ensure_tensor(tensor)
+        t._value = _cross_process_collective(t._value, _op_name(op))
+        return _maybe_task(t, sync_op)
     return _maybe_task(tensor, sync_op)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    if jax.process_count() > 1:
+        import jax.numpy as jnp
+
+        t = _ensure_tensor(tensor)
+        # zeros_like, NOT value*0: a non-src rank holding inf/NaN must
+        # contribute exactly zero (reference broadcast ignores non-src
+        # payloads entirely)
+        contrib = t._value if jax.process_index() == int(src) else (
+            jnp.zeros_like(t._value)
+        )
+        t._value = _cross_process_collective(contrib, "sum")
+        return _maybe_task(t, sync_op)
     return _maybe_task(tensor, sync_op)
 
 
@@ -169,6 +282,14 @@ def barrier(group=None):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     n = get_world_size(group)
     t = _ensure_tensor(tensor)
+    if jax.process_count() > 1:
+        stacked = _cross_process_collective(t._value, "gather")
+        rows = [Tensor(stacked[i]) for i in range(stacked.shape[0])]
+        if isinstance(tensor_list, list):
+            del tensor_list[:]
+            tensor_list.extend(rows)
+            return _maybe_task(tensor_list, sync_op)
+        return _maybe_task(rows, sync_op)
     if isinstance(tensor_list, list):
         del tensor_list[:]
         tensor_list.extend(Tensor(t._value) for _ in range(max(n, 1)))

@@ -58,6 +58,10 @@ def _worker_env(args, local_rank):
     global_rank = args.rank * args.nproc_per_node + local_rank
     env["PADDLE_TRAINERS_NUM"] = str(world)
     env["PADDLE_TRAINER_ID"] = str(global_rank)
+    # launcher-private marker: only OUR workers rendezvous at import
+    # (inherited PADDLE_* vars alone must not make grandchild processes
+    # join the coordination service as duplicates)
+    env["PADDLE_TPU_LAUNCHED"] = "1"
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
     env["PADDLE_NNODES"] = str(args.nnodes)
     env["PADDLE_JOB_ID"] = args.job_id

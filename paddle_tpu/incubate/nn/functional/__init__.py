@@ -226,6 +226,17 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     from ....ops.pallas.varlen_flash_attention import varlen_flash_attention
     from ....tensor._helpers import apply
 
+    if use_neox_rotary_style or any(
+        kwargs.get(k) is not None
+        for k in ("rotary_embs", "qkv_bias", "qkv_out_scale",
+                  "cache_k_quant_scales", "cache_v_quant_scales",
+                  "out_shift", "out_smooth")
+    ):
+        # silently ignoring these would produce numerically wrong
+        # attention (the reference applies them inside the op)
+        raise NotImplementedError(
+            "block_multihead_attention: rotary/bias/quant fusion args are "
+            "not supported here — apply rope/bias before the call")
     qkv = ensure_tensor(qkv)
     key_cache = ensure_tensor(key_cache)
     value_cache = ensure_tensor(value_cache)
@@ -257,7 +268,8 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     # this_time tokens and attend over cache + new via the varlen kernel's
     # bottom-right causal alignment.
     enc_lens = np.asarray(ensure_tensor(seq_lens_encoder)._value)
-    is_prefill_row = (this_time > 1) | (enc_lens > 0)
+    active = this_time > 0  # finished/inactive slots contribute nothing
+    is_prefill_row = ((this_time > 1) | (enc_lens > 0)) & active
     cu_all = np.concatenate([[0], np.cumsum(this_time)]).astype(np.int32)
     tbl_np = np.asarray(tables)
 
@@ -270,7 +282,7 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     offs = jnp.asarray((abs_pos % bs).astype(np.int32))
 
     pre_rows = np.nonzero(is_prefill_row)[0]
-    dec_rows = np.nonzero(~is_prefill_row)[0]
+    dec_rows = np.nonzero(~is_prefill_row & active)[0]
     # token indices of each group, in packed order
     pre_tok = np.concatenate(
         [np.arange(cu_all[i], cu_all[i + 1]) for i in pre_rows]

@@ -269,3 +269,64 @@ def test_block_mha_chunked_prefill_attends_cache():
         jnp.asarray([0, 6], jnp.int32), jnp.asarray([0, 16], jnp.int32),
         d ** -0.5, True)
     np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_block_mha_inactive_rows_skipped():
+    """this_time==0 slots (finished sequences) must contribute nothing
+    and not corrupt other rows (round-3 review finding)."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+
+    rng = np.random.RandomState(5)
+    h, hk, d, bs = 4, 2, 64, 32
+    pool = PagedKVCachePool(num_blocks=8, block_size=bs, num_kv_heads=hk,
+                            head_dim=d, dtype=jnp.float32)
+    cached_k = rng.randn(12, hk, d).astype("f4")
+    cached_v = rng.randn(12, hk, d).astype("f4")
+    kcache_np = np.zeros((8, bs, hk, d), "f4")
+    vcache_np = np.zeros_like(kcache_np)
+    pool.ensure(1, 12)
+    t1 = pool._tables[1]
+    for pos in range(12):
+        kcache_np[t1[pos // bs], pos % bs] = cached_k[pos]
+        vcache_np[t1[pos // bs], pos % bs] = cached_v[pos]
+    pool.ensure(1, 13)
+    kcache, vcache = paddle.to_tensor(kcache_np), paddle.to_tensor(vcache_np)
+
+    # row0 finished (this_time 0), row1 decoding — one token total
+    qkv_np = rng.randn(1, (h + 2 * hk) * d).astype("f4")
+    out = block_multihead_attention(
+        paddle.to_tensor(qkv_np), kcache, vcache,
+        seq_lens_encoder=paddle.to_tensor(np.asarray([0, 0], "i4")),
+        seq_lens_decoder=paddle.to_tensor(np.asarray([0, 12], "i4")),
+        seq_lens_this_time=paddle.to_tensor(np.asarray([0, 1], "i4")),
+        block_tables=paddle.to_tensor(
+            np.asarray(pool.block_table_array(range(2)))),
+        num_heads=h, kv_num_heads=hk,
+    ).numpy().reshape(1, h, d)
+
+    q = qkv_np[:, : h * d].reshape(1, h, d)
+    k = qkv_np[:, h * d : (h + hk) * d].reshape(1, hk, d)
+    v = qkv_np[:, (h + hk) * d :].reshape(1, hk, d)
+    kc_full = np.concatenate([cached_k, k], 0)[None]
+    vc_full = np.concatenate([cached_v, v], 0)[None]
+    ref = decode_attention(jnp.asarray(q), jnp.asarray(kc_full),
+                           jnp.asarray(vc_full),
+                           jnp.asarray([13], jnp.int32))
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_block_mha_rejects_unsupported_fusions():
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+
+    with pytest.raises(NotImplementedError, match="rotary"):
+        block_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 8 * 64), "f4")),
+            paddle.to_tensor(np.zeros((2, 32, 2, 64), "f4")),
+            paddle.to_tensor(np.zeros((2, 32, 2, 64), "f4")),
+            seq_lens_encoder=paddle.to_tensor(np.zeros(1, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.zeros(1, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.ones(1, "i4")),
+            block_tables=paddle.to_tensor(np.zeros((1, 1), "i4")),
+            num_heads=4, kv_num_heads=2,
+            rotary_embs=paddle.to_tensor(np.zeros(4, "f4")),
+        )
