@@ -130,3 +130,10 @@ from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402
 from . import sysconfig  # noqa: E402
+
+# populate the kernel-registry analog once the whole surface exists
+from .core.dispatch import (  # noqa: E402
+    OP_REGISTRY, register_op, populate_op_registry as _pop_reg,
+)
+
+_pop_reg()

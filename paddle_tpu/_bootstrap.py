@@ -29,9 +29,22 @@ def rendezvous_from_env():
     coordinator = os.environ.get("PADDLE_MASTER") or os.environ.get(
         "MASTER_ADDR", "127.0.0.1:8701"
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=n,
-        process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-    )
+    # consume the marker BEFORE initializing: grandchild processes that
+    # inherit the env must not try to join as duplicate process_ids
+    os.environ.pop(LAUNCHER_MARKER, None)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=n,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            raise RuntimeError(
+                "multi-process rendezvous requires the PADDLE_* env to be "
+                "set BEFORE `import paddle_tpu` (importing touches the "
+                "XLA backend). Use paddle.distributed.launch, or export "
+                "the env first."
+            ) from e
+        raise
     return True

@@ -43,6 +43,20 @@ class _AmpState:
 amp_state = _AmpState()
 
 
+def _known_op_names():
+    """Registry names plus bare seam aliases (`functional.relu` → also
+    `relu`): AMP lists traditionally use the bare op name."""
+    from ..core.dispatch import OP_REGISTRY, SEAM_OPS
+
+    names = set(OP_REGISTRY) | set(SEAM_OPS)
+    names.update(n.rsplit(".", 1)[-1] for n in OP_REGISTRY)
+    # built-in list entries are valid by definition (some are seam names
+    # only recorded at first execution)
+    names.update(WHITE_LIST)
+    names.update(BLACK_LIST)
+    return names
+
+
 def cast_inputs_for_op(op_name, vals):
     """Called from dispatch.apply when amp is on; casts float arrays."""
     st = amp_state
@@ -82,6 +96,19 @@ class auto_cast:
         self._black = set(custom_black_list or ())
         self._level = level
         self._dtype = to_jax_dtype(dtype)
+        # custom lists key on registered op names (the kernel-registry
+        # analog); an unknown name would silently never match — warn
+        unknown = (self._white | self._black) - _known_op_names()
+        if unknown:
+            import warnings
+
+            warnings.warn(
+                f"auto_cast: op names not (yet) in the op registry: "
+                f"{sorted(unknown)}. A dispatch-seam op name will still "
+                f"match once that op runs; check "
+                f"paddle.utils.get_registered_ops() for known names.",
+                RuntimeWarning,
+            )
 
     def __enter__(self):
         self._saved = (

@@ -17,10 +17,15 @@ import jax.numpy as jnp
 from . import autograd
 from .flags import get_flags
 
-__all__ = ["apply", "unwrap", "wrap_single", "OP_REGISTRY", "register_op"]
+__all__ = ["apply", "unwrap", "wrap_single", "OP_REGISTRY", "SEAM_OPS",
+           "register_op"]
 
 # op name → python callable (introspection / paddle "kernel registry" analog)
 OP_REGISTRY: dict[str, object] = {}
+# dispatch-seam op names observed at runtime (AMP's lists key on these).
+# A name-only SET: storing apply()'s per-call closures would pin their
+# captured arrays for the process lifetime
+SEAM_OPS: set[str] = set()
 
 _amp_cache = None
 
@@ -41,6 +46,41 @@ def _amp():
 def register_op(name: str, fn):
     OP_REGISTRY[name] = fn
     return fn
+
+
+def populate_op_registry():
+    """Fill OP_REGISTRY with the framework's public op surface — the
+    paddle "kernel registry" analog (reference: PD_REGISTER_KERNEL /
+    phi::KernelFactory, SURVEY.md §2.1 — unverified). Registered:
+
+    - every public callable on ``paddle.*`` (tensor/creation/math/...)
+    - ``paddle.nn.functional.*`` under ``functional.<name>``
+    - namespace APIs (linalg/fft/signal/sparse/geometric) under
+      ``<ns>.<name>``
+
+    Dispatch-seam op names (the strings ``apply(op_name=...)`` uses, which
+    AMP's white/black lists key on) are additionally recorded at first
+    execution by ``apply`` itself.
+    """
+    import inspect
+    import paddle_tpu as _p
+
+    def take(ns, prefix=""):
+        for name in dir(ns):
+            if name.startswith("_"):
+                continue
+            fn = getattr(ns, name, None)
+            if inspect.isfunction(fn) or inspect.isbuiltin(fn):
+                OP_REGISTRY.setdefault(prefix + name, fn)
+
+    take(_p)
+    take(_p.nn.functional, "functional.")
+    for ns_name in ("linalg", "fft", "signal", "sparse", "geometric",
+                    "incubate"):
+        ns = getattr(_p, ns_name, None)
+        if ns is not None:
+            take(ns, ns_name + ".")
+    return len(OP_REGISTRY)
 
 
 def unwrap(x):
@@ -96,6 +136,8 @@ def apply(fn, *args, op_name: str = "", **kwargs):
     """
     from .tensor import Tensor
 
+    if op_name:
+        SEAM_OPS.add(op_name)
     vals = [unwrap(a) for a in args]
     # AMP: cast inputs per white/black list before tracing the op.
     amp = _amp()

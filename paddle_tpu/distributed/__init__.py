@@ -86,12 +86,14 @@ def init_parallel_env():
     if parallel_env._initialized:
         return parallel_env
     # normally already rendezvoused at `import paddle_tpu` (the backend
-    # must not be touched first); this covers direct embedders that set
-    # the env protocol themselves after import
+    # must not be touched first). rendezvous_from_env no-ops on a
+    # single-process env, no-ops if the coordination client exists, and
+    # raises with guidance if the backend was already initialized
+    # (jax.process_count() here would itself initialize it, so it must
+    # NOT be consulted before the helper).
     from .._bootstrap import rendezvous_from_env
 
-    if jax.process_count() == 1:
-        rendezvous_from_env()
+    rendezvous_from_env()
     parallel_env._initialized = True
     return parallel_env
 
@@ -171,16 +173,18 @@ def _collective_fn(op_name, shape, dtype_str, n):
 
     mesh = _world_mesh_one_dev_per_proc()
 
+    def gather(x):
+        # one-hot scatter + psum: psum's replication is statically
+        # inferable by shard_map (lax.all_gather's is not)
+        return jax.lax.psum(
+            jnp.zeros((n, *x.shape[1:]), x.dtype)
+            .at[jax.lax.axis_index("world")].set(x[0]),
+            "world",
+        )
+
     def prod(x):
-        # sign-tracking product: exp(psum(log|x|)) * (-1)^(neg count) —
-        # a plain log would NaN on negative elements
-        mag = jnp.exp(jax.lax.psum(
-            jnp.log(jnp.maximum(jnp.abs(x.astype(jnp.float32)), 1e-38)),
-            "world"))
-        negs = jax.lax.psum((x < 0).astype(jnp.int32), "world")
-        zeros = jax.lax.psum((x == 0).astype(jnp.int32), "world")
-        signed = jnp.where(negs % 2 == 1, -mag, mag)
-        return jnp.where(zeros > 0, 0.0, signed).astype(x.dtype)
+        # exact (ints included): gather all contributions, multiply
+        return jnp.prod(gather(x), axis=0)
 
     red = {
         "sum": lambda x: jax.lax.psum(x, "world"),
@@ -188,13 +192,7 @@ def _collective_fn(op_name, shape, dtype_str, n):
         "max": lambda x: jax.lax.pmax(x, "world"),
         "min": lambda x: jax.lax.pmin(x, "world"),
         "prod": prod,
-        # gather as one-hot scatter + psum: psum's replication is
-        # statically inferable by shard_map (lax.all_gather's is not)
-        "gather": lambda x: jax.lax.psum(
-            jnp.zeros((n, *x.shape[1:]), x.dtype)
-            .at[jax.lax.axis_index("world")].set(x[0]),
-            "world",
-        ),
+        "gather": gather,
     }[op_name]
     fn = shard_map(
         lambda x: red(x)[0] if op_name != "gather" else red(x),
@@ -357,3 +355,4 @@ from . import auto_parallel  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from . import elastic  # noqa: E402,F401
+from . import rpc  # noqa: E402,F401

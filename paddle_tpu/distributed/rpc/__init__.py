@@ -1,0 +1,120 @@
+"""paddle.distributed.rpc — API-shaped facade (reference:
+python/paddle/distributed/rpc/ over brpc — unverified, SURVEY.md §2.3
+RPC row).
+
+Scope decision (recorded in COVERAGE.md): the reference's rpc utility
+exists to move Python closures between trainer processes for
+parameter-server-style workloads. A TPU training/serving stack is
+single-controller (or SPMD multi-controller) — there is no brpc fabric
+and cross-host Python RPC is a non-goal. This facade keeps the API
+importable and genuinely functional within a process (local execution,
+async via a thread pool); cross-process calls raise with guidance
+rather than pretending.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor, Future
+
+import jax
+
+__all__ = [
+    "init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+    "get_all_worker_infos", "get_current_worker_info", "WorkerInfo",
+]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip="127.0.0.1", port=0):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name!r}, rank={self.rank}, "
+                f"ip={self.ip!r}, port={self.port})")
+
+
+class _RpcState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.workers: dict[str, WorkerInfo] = {}
+        self.current: WorkerInfo | None = None
+        self.pool: ThreadPoolExecutor | None = None
+
+
+_state = _RpcState()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Register this process as an rpc worker. Single-process (or one
+    worker per launched process) only — see the module docstring."""
+    with _state.lock:
+        rank = jax.process_index() if rank is None else int(rank)
+        info = WorkerInfo(name, rank)
+        _state.workers[name] = info
+        _state.current = info
+        if _state.pool is None:
+            _state.pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="paddle-rpc")
+    return info
+
+
+def _resolve(to):
+    if _state.current is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    if isinstance(to, WorkerInfo):
+        to = to.name
+    info = _state.workers.get(to)
+    if info is None:
+        raise RuntimeError(
+            f"unknown rpc worker {to!r}; cross-process rpc is a non-goal "
+            "on the TPU stack (no brpc fabric) — use "
+            "paddle.distributed collectives or a real RPC system"
+        )
+    if info.rank != _state.current.rank:
+        raise NotImplementedError(
+            "cross-process paddle.distributed.rpc is a documented "
+            "non-goal on the TPU stack; collectives cover SPMD "
+            "communication (see COVERAGE.md)"
+        )
+    return info
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    """Run ``fn`` on worker ``to`` and return its result (local-only)."""
+    _resolve(to)
+    return fn(*(args or ()), **(kwargs or {}))
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """Async variant; returns a Future with .result()/.wait()."""
+    _resolve(to)
+    fut = _state.pool.submit(fn, *(args or ()), **(kwargs or {}))
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # paddle's handle spells it wait()
+    return fut
+
+
+def shutdown():
+    with _state.lock:
+        if _state.pool is not None:
+            _state.pool.shutdown(wait=True)
+            _state.pool = None
+        _state.workers.clear()
+        _state.current = None
+
+
+def get_worker_info(name):
+    return _state.workers[name]
+
+
+def get_all_worker_infos():
+    return list(_state.workers.values())
+
+
+def get_current_worker_info():
+    if _state.current is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _state.current

@@ -428,6 +428,126 @@ def take(x, index, mode="raise", name=None):
     )
 
 
+# -- special-function long tail (round-3: SURVEY §2.4 op-corpus row,
+# reference python/paddle/tensor/math.py — unverified) ---------------------
+def polygamma(x, n, name=None):
+    """n-th derivative of the digamma function (paddle.polygamma)."""
+    order = int(n)
+    if order < 0:
+        raise ValueError(f"polygamma order must be >= 0, got {order}")
+    return apply(
+        lambda v: jax.scipy.special.polygamma(order, v),
+        ensure_tensor(x), op_name="polygamma",
+    )
+
+
+def igamma(x, y, name=None):
+    """Regularized UPPER incomplete gamma Q(x, y) (paddle.igamma)."""
+    return apply(
+        lambda a, b: jax.scipy.special.gammaincc(a, b),
+        ensure_tensor(x), ensure_tensor(y), op_name="igamma",
+    )
+
+
+def igammac(x, y, name=None):
+    """Regularized LOWER incomplete gamma P(x, y) (paddle.igammac)."""
+    return apply(
+        lambda a, b: jax.scipy.special.gammainc(a, b),
+        ensure_tensor(x), ensure_tensor(y), op_name="igammac",
+    )
+
+
+gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
+gammainc = igammac  # paddle.gammainc(x, y) = P(x, y)
+gammaincc = igamma
+i0e = _unary(lambda x: jax.scipy.special.i0e(x), "i0e")
+i1e = _unary(lambda x: jax.scipy.special.i1e(x), "i1e")
+
+
+def multigammaln(x, p, name=None):
+    """Log of the multivariate gamma function (paddle.multigammaln)."""
+    order = int(p)
+
+    def fn(v):
+        # NB: builtins.sum, not this module's paddle `sum` reduction
+        acc = jnp.asarray(0.25 * order * (order - 1) * jnp.log(jnp.pi),
+                          v.dtype)
+        for i in range(order):
+            acc = acc + jax.scipy.special.gammaln(v - 0.5 * i)
+        return acc
+
+    return apply(fn, ensure_tensor(x), op_name="multigammaln")
+
+
+isposinf = _unary(jnp.isposinf, "isposinf")
+isneginf = _unary(jnp.isneginf, "isneginf")
+isreal = _unary(jnp.isreal, "isreal")
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition; returns (mantissa, exponent)
+    with exponent as the input's dtype (paddle convention)."""
+    xt = ensure_tensor(x)
+
+    def fn(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(v.dtype)
+
+    return apply(fn, xt, op_name="frexp")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (paddle.combinations)."""
+    import itertools
+
+    xt = ensure_tensor(x)
+    if xt.ndim != 1:
+        raise ValueError("combinations expects a 1-D tensor")
+    n = xt.shape[0]
+    picker = (itertools.combinations_with_replacement if with_replacement
+              else itertools.combinations)
+    idx = list(picker(range(n), int(r)))
+    if not idx:
+        import numpy as _np
+
+        return apply(lambda v: jnp.zeros((0, int(r)), v.dtype), xt,
+                     op_name="combinations")
+    import numpy as _np
+
+    idx_arr = _np.asarray(idx, _np.int32)
+    return apply(lambda v: v[idx_arr], xt, op_name="combinations")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (paddle.cumulative_trapezoid)."""
+    yt = ensure_tensor(y)
+
+    def fn(v, *maybe_x):
+        ax = axis % v.ndim
+        sl_lo = [slice(None)] * v.ndim
+        sl_hi = [slice(None)] * v.ndim
+        sl_lo[ax] = slice(None, -1)
+        sl_hi[ax] = slice(1, None)
+        avg = (v[tuple(sl_lo)] + v[tuple(sl_hi)]) * 0.5
+        if maybe_x:
+            xv = maybe_x[0]
+            if xv.ndim == 1:
+                shape = [1] * v.ndim
+                shape[ax] = -1
+                xv = xv.reshape(shape)
+            d = xv[tuple(sl_hi)] - xv[tuple(sl_lo)] if xv.ndim == v.ndim \
+                else jnp.diff(xv, axis=ax)
+            avg = avg * d
+        else:
+            avg = avg * (1.0 if dx is None else dx)
+        return jnp.cumsum(avg, axis=ax)
+
+    if x is not None:
+        return apply(fn, yt, ensure_tensor(x),
+                     op_name="cumulative_trapezoid")
+    return apply(fn, yt, op_name="cumulative_trapezoid")
+
+
 # __all__ is assembled from the ops defined in this module so star-imports
 # and Tensor method patching never leak helpers (jax/jnp/Tensor/apply...).
 __all__ = [

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .nn.layer.layers import Layer
 from .tensor._helpers import apply, ensure_tensor
@@ -90,3 +91,77 @@ class ViterbiDecoder(Layer):
             potentials, self.transitions, lengths,
             self.include_bos_eos_tag,
         )
+
+
+# -- datasets (reference: python/paddle/text/datasets/ — unverified,
+# SURVEY.md §0). Zero-egress: loads from a local archive path. ----------
+class Imdb:
+    """IMDB sentiment dataset from a local aclImdb tar archive
+    (paddle.text.datasets.Imdb parity: tokenized docs + 0/1 labels,
+    word_idx built from the train split with a frequency cutoff).
+
+    Args:
+        data_file: path to ``aclImdb_v1.tar.gz`` (or a compatible tar
+            containing ``aclImdb/<mode>/<pos|neg>/*.txt``).
+        mode: "train" or "test".
+        cutoff: minimum word frequency for the vocabulary.
+    """
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        import re
+        import tarfile
+        from collections import Counter
+
+        if data_file is None or not __import__("os").path.exists(data_file):
+            raise RuntimeError(
+                "Imdb needs a local aclImdb archive (zero-egress "
+                "environment): pass data_file=/path/to/aclImdb_v1.tar.gz"
+            )
+        self.mode = mode
+        pat = re.compile(r"aclImdb/%s/(pos|neg)/.*\.txt$" % mode)
+        train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        tok = re.compile(r"[a-z]+")
+        docs_raw, labels = [], []
+        counter = Counter()
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                name = member.name
+                is_cur = bool(pat.match(name))
+                is_train = bool(train_pat.match(name))
+                if not (is_cur or is_train):
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                words = tok.findall(text)
+                if is_train:
+                    counter.update(words)
+                if is_cur:
+                    docs_raw.append(words)
+                    labels.append(0 if "/pos/" in name else 1)
+        vocab = sorted(
+            (w for w, c in counter.items() if c >= cutoff),
+            key=lambda w: (-counter[w], w),
+        )
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [
+            np.asarray([self.word_idx.get(w, unk) for w in ws], np.int64)
+            for ws in docs_raw
+        ]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+
+class _DatasetsNS:
+    """paddle.text.datasets namespace object."""
+
+    Imdb = Imdb
+
+
+datasets = _DatasetsNS()

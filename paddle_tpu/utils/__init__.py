@@ -64,3 +64,23 @@ class _UniqueNames:
 
 
 unique_name = _UniqueNames()
+
+
+def get_registered_ops():
+    """Names in the op registry (the reference's get_all_op_names analog:
+    phi kernel registry — SURVEY.md §2.1, unverified). Includes the
+    public ``paddle.*``/``functional.*`` surface registered at import and
+    dispatch-seam op names recorded at first execution (name-only)."""
+    from ..core.dispatch import OP_REGISTRY, SEAM_OPS
+
+    return sorted(set(OP_REGISTRY) | SEAM_OPS)
+
+
+def get_op_callable(name):
+    """The python callable registered for ``name`` (KeyError if absent)."""
+    from ..core.dispatch import OP_REGISTRY
+
+    return OP_REGISTRY[name]
+
+
+__all__ += ["get_registered_ops", "get_op_callable"]

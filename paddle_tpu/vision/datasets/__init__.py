@@ -110,3 +110,108 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     pass
+
+
+class DatasetFolder(Dataset):
+    """Generic folder dataset: ``root/<class>/**/<file>`` (reference:
+    python/paddle/vision/datasets/folder.py — unverified). ``loader``
+    maps a path to a sample; default loads images via PIL when present,
+    else raw ``np.load``-able / byte files are rejected with a clear
+    error."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise RuntimeError(f"no class folders found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    if is_valid_file is not None:
+                        ok = is_valid_file(path)
+                    else:
+                        ok = fname.lower().endswith(tuple(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"no valid files under {root} (extensions={extensions})")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.int64(target)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def default_loader(path):
+    """PIL image → HWC uint8 array; ``.npy`` files load directly."""
+    if path.lower().endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            f"loading {path} needs Pillow; save arrays as .npy instead"
+        ) from e
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+class ImageFolder(Dataset):
+    """Unlabelled flat/nested image folder (reference:
+    python/paddle/vision/datasets/folder.py ImageFolder — unverified):
+    every valid file under root is one sample; no class subdirs."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                if is_valid_file is not None:
+                    ok = is_valid_file(path)
+                else:
+                    ok = fname.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "default_loader"]

@@ -324,3 +324,21 @@ def test_nan_check_fires_inside_jit():
             np.asarray(jax.jit(f)(np.array([-1.0], "f4")))
     finally:
         set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_rpc_facade_local_and_nongoal_semantics():
+    """paddle.distributed.rpc: functional within a process, loud
+    documented non-goal across processes (round-2 verdict item 10)."""
+    import paddle_tpu.distributed.rpc as rpc
+
+    info = rpc.init_rpc("worker0")
+    assert rpc.get_current_worker_info() is info
+    assert rpc.get_worker_info("worker0").name == "worker0"
+    assert rpc.rpc_sync("worker0", lambda a, b: a + b, args=(2, 3)) == 5
+    fut = rpc.rpc_async("worker0", lambda: 42)
+    assert fut.result() == 42 and fut.wait() == 42
+    with pytest.raises(RuntimeError, match="unknown rpc worker"):
+        rpc.rpc_sync("elsewhere", lambda: None)
+    rpc.shutdown()
+    with pytest.raises(RuntimeError, match="init_rpc"):
+        rpc.get_current_worker_info()

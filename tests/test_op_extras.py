@@ -6,6 +6,9 @@ import pytest
 import paddle_tpu as paddle
 
 
+from op_test import OpTest
+
+
 def _t(a):
     return paddle.to_tensor(np.asarray(a))
 
@@ -242,3 +245,104 @@ def test_lu_unpack_flags():
     assert L is None and U is None and P is not None
     P2, L2, U2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
     assert P2 is None and L2 is not None
+
+
+class TestSpecialFunctionTail(OpTest):
+    """Round-3 op-corpus tail: polygamma, igamma family, multigammaln,
+    frexp, combinations, cumulative_trapezoid (OpTest semantics:
+    numpy-oracle forward + finite-difference gradients)."""
+
+    def test_polygamma(self):
+        from scipy import special as sp
+
+        x = np.random.RandomState(0).uniform(0.5, 4.0, (3, 5)).astype("f4")
+        for n in (0, 1, 2):
+            self.check_output(
+                lambda t, n=n: paddle.polygamma(t, n),
+                lambda a, n=n: sp.polygamma(n, a).astype("f4"), [x])
+        self.check_grad(lambda t: paddle.polygamma(t, 1), [x])
+
+    def test_igamma_family(self):
+        from scipy import special as sp
+
+        rng = np.random.RandomState(1)
+        a = rng.uniform(0.5, 3.0, (4, 4)).astype("f4")
+        x = rng.uniform(0.1, 5.0, (4, 4)).astype("f4")
+        self.check_output(paddle.igamma,
+                          lambda u, v: sp.gammaincc(u, v).astype("f4"),
+                          [a, x])
+        self.check_output(paddle.igammac,
+                          lambda u, v: sp.gammainc(u, v).astype("f4"),
+                          [a, x])
+        assert paddle.gammainc is paddle.igammac
+        assert paddle.gammaincc is paddle.igamma
+
+    def test_gammaln_multigammaln(self):
+        from scipy import special as sp
+
+        self.rtol, self.atol = 2e-4, 2e-4  # f32 gammaln tail accuracy
+        x = np.random.RandomState(2).uniform(1.5, 6.0, (6,)).astype("f4")
+        self.check_output(paddle.gammaln,
+                          lambda a: sp.gammaln(a).astype("f4"), [x])
+        def mg_ref(a, p=3):
+            # elementwise oracle (scipy.multigammaln reduces over arrays)
+            out = 0.25 * p * (p - 1) * np.log(np.pi)
+            return (out + sum(sp.gammaln(a - 0.5 * i)
+                              for i in range(p))).astype("f4")
+
+        self.check_output(lambda t: paddle.multigammaln(t, 3), mg_ref, [x])
+        self.check_grad(lambda t: paddle.multigammaln(t, 2), [x])
+
+    def test_i0e_i1e(self):
+        from scipy import special as sp
+
+        x = np.random.RandomState(3).uniform(-4, 4, (8,)).astype("f4")
+        self.check_output(paddle.i0e,
+                          lambda a: sp.i0e(a).astype("f4"), [x])
+        self.check_output(paddle.i1e,
+                          lambda a: sp.i1e(a).astype("f4"), [x])
+
+    def test_frexp(self):
+        x = np.asarray([0.5, 3.0, -8.25, 100.0], "f4")
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        rm, re = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), rm, rtol=1e-6)
+        np.testing.assert_allclose(e.numpy(), re.astype("f4"))
+
+    def test_inf_predicates(self):
+        x = np.asarray([1.0, np.inf, -np.inf, np.nan], "f4")
+        np.testing.assert_array_equal(
+            paddle.isposinf(paddle.to_tensor(x)).numpy(), np.isposinf(x))
+        np.testing.assert_array_equal(
+            paddle.isneginf(paddle.to_tensor(x)).numpy(), np.isneginf(x))
+        assert bool(paddle.isreal(paddle.to_tensor(x)).numpy().all())
+
+    def test_combinations(self):
+        import itertools
+
+        x = np.asarray([10., 20., 30., 40.], "f4")
+        out = paddle.combinations(paddle.to_tensor(x), r=2).numpy()
+        ref = np.asarray(list(itertools.combinations(x, 2)), "f4")
+        np.testing.assert_array_equal(out, ref)
+        out_wr = paddle.combinations(
+            paddle.to_tensor(x), r=2, with_replacement=True).numpy()
+        ref_wr = np.asarray(
+            list(itertools.combinations_with_replacement(x, 2)), "f4")
+        np.testing.assert_array_equal(out_wr, ref_wr)
+
+    def test_cumulative_trapezoid(self):
+        rng = np.random.RandomState(4)
+        y = rng.randn(3, 7).astype("f4")
+        xs = np.sort(rng.rand(7)).astype("f4")
+        from scipy import integrate as si
+
+        self.check_output(
+            lambda t: paddle.cumulative_trapezoid(t, dx=0.5),
+            lambda a: si.cumulative_trapezoid(a, dx=0.5, axis=-1).astype("f4"),
+            [y])
+        self.check_output(
+            lambda t, xt: paddle.cumulative_trapezoid(t, xt),
+            lambda a, b: si.cumulative_trapezoid(a, b, axis=-1).astype("f4"),
+            [y, xs])
+        self.check_grad(
+            lambda t: paddle.cumulative_trapezoid(t, dx=0.25), [y])

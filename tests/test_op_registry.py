@@ -1,0 +1,59 @@
+"""The kernel-registry analog (reference: phi::KernelFactory /
+PD_REGISTER_KERNEL, SURVEY.md §2.1 — unverified): populated at import
+from the public op surface, extended at dispatch time with seam names,
+introspectable via paddle.utils, and backing AMP list validation."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_registry_populated_at_import():
+    assert len(paddle.OP_REGISTRY) >= 400, len(paddle.OP_REGISTRY)
+    for name in ("matmul", "concat", "exp", "functional.softmax",
+                 "functional.relu", "functional.cross_entropy",
+                 "linalg.svd", "fft.fft"):
+        assert name in paddle.OP_REGISTRY, name
+    assert callable(paddle.OP_REGISTRY["matmul"])
+
+
+def test_dispatch_seam_names_recorded():
+    from paddle_tpu.core.dispatch import SEAM_OPS
+
+    x = paddle.to_tensor(np.random.randn(2, 8, 4, 64).astype("f4"))
+    import paddle_tpu.nn.functional as F
+
+    F.scaled_dot_product_attention(x, x, x)
+    assert ("flash_attention" in SEAM_OPS
+            or "scaled_dot_product_attention" in SEAM_OPS)
+    assert "flash_attention" in paddle.utils.get_registered_ops() or \
+        "scaled_dot_product_attention" in paddle.utils.get_registered_ops()
+
+
+def test_utils_introspection():
+    ops = paddle.utils.get_registered_ops()
+    assert ops == sorted(ops) and "matmul" in ops
+    assert callable(paddle.utils.get_op_callable("matmul"))
+    with pytest.raises(KeyError):
+        paddle.utils.get_op_callable("definitely_not_an_op_xyz")
+
+
+def test_register_op_decorator_seam():
+    def my_kernel(v):
+        return v + 1
+
+    paddle.register_op("custom_test_op", my_kernel)
+    assert paddle.OP_REGISTRY["custom_test_op"] is my_kernel
+
+
+def test_amp_custom_lists_validated_against_registry():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with paddle.amp.auto_cast(custom_white_list={"matmul"}):
+            pass
+    assert not [x for x in w if issubclass(x.category, RuntimeWarning)]
+    with pytest.warns(RuntimeWarning, match=r"not \(yet\) in the op registry"):
+        with paddle.amp.auto_cast(custom_white_list={"not_a_real_op_qq"}):
+            pass

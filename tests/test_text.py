@@ -58,3 +58,74 @@ def test_viterbi_decoder_layer_and_lengths():
     np.testing.assert_allclose(float(scores[1]), ref_s, rtol=1e-4)
     np.testing.assert_array_equal(
         np.asarray(paths._value)[1][:3], ref_p)
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    import numpy as np
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    root = tmp_path / "ds"
+    for cls in ("cat", "dog"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            np.save(d / f"{i}.npy", np.full((4, 4), i, "f4"))
+    ds = DatasetFolder(str(root))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (4, 4) and label in (0, 1)
+    labels = sorted(int(ds[i][1]) for i in range(6))
+    assert labels == [0, 0, 0, 1, 1, 1]
+    # transform applies
+    ds_t = DatasetFolder(str(root), transform=lambda a: a + 1)
+    assert float(ds_t[0][0].mean()) == float(ds[0][0].mean()) + 1
+
+    flat = ImageFolder(str(root))
+    assert len(flat) == 6
+    (sample,) = flat[2]
+    assert sample.shape == (4, 4)
+
+
+def test_imdb_dataset_from_local_archive(tmp_path):
+    import io
+    import tarfile
+
+    import numpy as np
+    import pytest
+    from paddle_tpu.text import Imdb
+
+    # build a tiny aclImdb-shaped archive
+    docs = {
+        "aclImdb/train/pos/0.txt": b"great great movie the the the",
+        "aclImdb/train/pos/1.txt": b"great fun the the",
+        "aclImdb/train/neg/0.txt": b"terrible movie the the the",
+        "aclImdb/train/neg/1.txt": b"boring the the",
+        "aclImdb/test/pos/0.txt": b"great the",
+        "aclImdb/test/neg/0.txt": b"terrible the",
+    }
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, content in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+
+    train = Imdb(data_file=str(path), mode="train", cutoff=2)
+    assert len(train) == 4
+    # vocabulary: words with freq >= 2 in train + <unk>
+    assert "the" in train.word_idx and "great" in train.word_idx
+    assert "<unk>" in train.word_idx
+    assert "boring" not in train.word_idx  # freq 1 < cutoff
+    doc, label = train[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    # labels: pos=0, neg=1 (reference convention)
+    labels = [int(train[i][1]) for i in range(4)]
+    assert sorted(labels) == [0, 0, 1, 1]
+
+    test = Imdb(data_file=str(path), mode="test", cutoff=2)
+    assert len(test) == 2  # same vocab source (train split)
+    assert test.word_idx == train.word_idx
+
+    with pytest.raises(RuntimeError, match="local aclImdb"):
+        Imdb(data_file=None)
