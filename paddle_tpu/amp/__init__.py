@@ -97,8 +97,10 @@ class auto_cast:
         self._level = level
         self._dtype = to_jax_dtype(dtype)
         # custom lists key on registered op names (the kernel-registry
-        # analog); an unknown name would silently never match — warn
-        unknown = (self._white | self._black) - _known_op_names()
+        # analog); an unknown name would silently never match — warn.
+        # Skip entirely for the plain (no custom lists) hot path.
+        unknown = ((self._white | self._black) - _known_op_names()
+                   if (self._white or self._black) else ())
         if unknown:
             import warnings
 

@@ -183,8 +183,9 @@ def _collective_fn(op_name, shape, dtype_str, n):
         )
 
     def prod(x):
-        # exact (ints included): gather all contributions, multiply
-        return jnp.prod(gather(x), axis=0)
+        # exact (ints included): gather all contributions, multiply.
+        # keepdims: the shared unshard wrapper strips the leading axis
+        return jnp.prod(gather(x), axis=0, keepdims=True)
 
     red = {
         "sum": lambda x: jax.lax.psum(x, "world"),

@@ -93,11 +93,9 @@ class Predictor:
         self._outputs = {}
 
     def _n_inputs(self):
-        ex = self._layer._exported
-        try:
-            return len(ex.in_avals) - len(self._layer._params)
-        except Exception:
-            return 1
+        # exact: recorded in the artifact at save time (older artifacts
+        # derive it from the export signature) — no guessing
+        return self._layer._n_inputs
 
     def get_input_names(self):
         return list(self._inputs)
@@ -130,9 +128,15 @@ class Predictor:
         return self._outputs[name]
 
     def clone(self):
-        import copy
-
-        return copy.copy(self)
+        """Per-thread clone (reference: AnalysisPredictor::Clone): the
+        compiled program + params are immutable and shared; the
+        input/output HANDLES are fresh so concurrent clones never race
+        on each other's tensors."""
+        new = object.__new__(Predictor)
+        new._layer = self._layer
+        new._inputs = {name: _Handle() for name in self._inputs}
+        new._outputs = {}
+        return new
 
 
 def create_predictor(config: Config) -> Predictor:

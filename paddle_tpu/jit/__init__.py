@@ -417,9 +417,15 @@ def ignore_module(modules):
 class TranslatedLayer:
     """Inference layer reconstructed from an exported program (jit.load)."""
 
-    def __init__(self, exported, params):
+    def __init__(self, exported, params, n_inputs=None):
         self._exported = exported
         self._params = params
+        # recorded at save time; older artifacts derive it from the
+        # export signature (inputs precede params in in_avals)
+        self._n_inputs = (
+            n_inputs if n_inputs is not None
+            else len(exported.in_avals) - len(params)
+        )
 
     def __call__(self, *args):
         vals = [a._value if isinstance(a, Tensor) else np.asarray(a) for a in args]
@@ -501,6 +507,7 @@ def save(layer, path, input_spec=None, **configs):
         f.write(blob)
     np.savez(
         path + ".pdiparams",
+        __n_inputs__=np.asarray(len(example_args), np.int64),
         **{
             f"p{i}": np.asarray(jax.device_get(p._value))
             for i, p in enumerate(params + buffers)
@@ -515,5 +522,8 @@ def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
     data = np.load(path + ".pdiparams.npz")
-    params = [data[f"p{i}"] for i in range(len(data.files))]
-    return TranslatedLayer(exported, params)
+    n_params = len([k for k in data.files if k.startswith("p")])
+    params = [data[f"p{i}"] for i in range(n_params)]
+    n_inputs = (int(data["__n_inputs__"]) if "__n_inputs__" in data.files
+                else None)
+    return TranslatedLayer(exported, params, n_inputs=n_inputs)

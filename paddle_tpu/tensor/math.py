@@ -508,8 +508,6 @@ def combinations(x, r=2, with_replacement=False, name=None):
               else itertools.combinations)
     idx = list(picker(range(n), int(r)))
     if not idx:
-        import numpy as _np
-
         return apply(lambda v: jnp.zeros((0, int(r)), v.dtype), xt,
                      op_name="combinations")
     import numpy as _np

@@ -112,6 +112,19 @@ class Cifar100(Cifar10):
     pass
 
 
+def _scan(root, extensions, is_valid_file):
+    """Recursive deterministic file scan shared by the folder datasets."""
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(dirpath, fname)
+            if is_valid_file is not None:
+                ok = is_valid_file(path)
+            else:
+                ok = fname.lower().endswith(tuple(extensions))
+            if ok:
+                yield path
+
+
 class DatasetFolder(Dataset):
     """Generic folder dataset: ``root/<class>/**/<file>`` (reference:
     python/paddle/vision/datasets/folder.py — unverified). ``loader``
@@ -134,18 +147,12 @@ class DatasetFolder(Dataset):
             raise RuntimeError(f"no class folders found under {root}")
         self.classes = classes
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
-        self.samples = []
-        for c in classes:
-            cdir = os.path.join(root, c)
-            for dirpath, _, files in sorted(os.walk(cdir)):
-                for fname in sorted(files):
-                    path = os.path.join(dirpath, fname)
-                    if is_valid_file is not None:
-                        ok = is_valid_file(path)
-                    else:
-                        ok = fname.lower().endswith(tuple(extensions))
-                    if ok:
-                        self.samples.append((path, self.class_to_idx[c]))
+        self.samples = [
+            (path, self.class_to_idx[c])
+            for c in classes
+            for path in _scan(os.path.join(root, c), extensions,
+                              is_valid_file)
+        ]
         if not self.samples:
             raise RuntimeError(
                 f"no valid files under {root} (extensions={extensions})")
@@ -191,16 +198,7 @@ class ImageFolder(Dataset):
         self.loader = loader or default_loader
         if extensions is None and is_valid_file is None:
             extensions = IMG_EXTENSIONS
-        self.samples = []
-        for dirpath, _, files in sorted(os.walk(root)):
-            for fname in sorted(files):
-                path = os.path.join(dirpath, fname)
-                if is_valid_file is not None:
-                    ok = is_valid_file(path)
-                else:
-                    ok = fname.lower().endswith(tuple(extensions))
-                if ok:
-                    self.samples.append(path)
+        self.samples = list(_scan(root, extensions, is_valid_file))
         if not self.samples:
             raise RuntimeError(f"no valid files under {root}")
 

@@ -220,3 +220,56 @@ def test_masked_multihead_attention_src_mask_and_validation():
     with pytest.raises(NotImplementedError, match="out_scale"):
         masked_multihead_attention(
             q, ckv, sequence_lengths=lens, out_scale=0.5)
+
+
+def test_predictor_exact_inputs_and_clone_isolation(tmp_path):
+    """Round-2 weak #8: input count is recorded in the artifact (no
+    heuristics — a 2-input model exposes exactly 2 handles) and clone()
+    gives independent handles over the shared compiled program."""
+    import paddle_tpu.inference as infer
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.lin(a) + self.lin(b)
+
+    paddle.seed(0)
+    net = TwoIn()
+    net.eval()
+    path = os.path.join(str(tmp_path), "twoin")
+    paddle.jit.save(net, path, input_spec=[
+        InputSpec([2, 8], "float32"), InputSpec([2, 8], "float32")])
+
+    pred = infer.create_predictor(infer.Config(path))
+    names = pred.get_input_names()
+    assert len(names) == 2, names
+
+    rng = np.random.RandomState(0)
+    a, b = rng.randn(2, 8).astype("f4"), rng.randn(2, 8).astype("f4")
+    pred.get_input_handle(names[0]).copy_from_cpu(a)
+    pred.get_input_handle(names[1]).copy_from_cpu(b)
+
+    clone = pred.clone()
+    assert clone._layer is pred._layer  # compiled program shared
+    # clone handles are fresh: not the same objects, no inherited data
+    for n in names:
+        assert clone.get_input_handle(n) is not pred.get_input_handle(n)
+        assert clone.get_input_handle(n)._value is None
+
+    # fill the clone with different data; both must produce their own
+    a2, b2 = rng.randn(2, 8).astype("f4"), rng.randn(2, 8).astype("f4")
+    clone.get_input_handle(names[0]).copy_from_cpu(a2)
+    clone.get_input_handle(names[1]).copy_from_cpu(b2)
+    pred.run()
+    clone.run()
+    out1 = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    out2 = clone.get_output_handle(clone.get_output_names()[0]).copy_to_cpu()
+    ref1 = net(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    ref2 = net(paddle.to_tensor(a2), paddle.to_tensor(b2)).numpy()
+    np.testing.assert_allclose(out1, ref1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out2, ref2, rtol=1e-5, atol=1e-5)

@@ -156,6 +156,12 @@ def test_two_process_rendezvous_and_collective(tmp_path):
         "g = paddle.to_tensor(np.asarray([float(rank)], 'f4'))\n"
         "dist.all_gather(outs, g)\n"
         "print('GATHER', rank, [float(np.asarray(t._value)[0]) for t in outs])\n"
+        "p = paddle.to_tensor(np.asarray([2.0, 3.0], 'f4') + rank)\n"
+        "dist.all_reduce(p, op=dist.ReduceOp.PROD)\n"
+        "print('PROD', rank, [float(v) for v in np.asarray(p._value)])\n"
+        "a = paddle.to_tensor(np.asarray([float((rank + 1) * 4)], 'f4'))\n"
+        "dist.all_reduce(a, op=dist.ReduceOp.AVG)\n"
+        "print('AVG', rank, float(np.asarray(a._value)[0]))\n"
     )
     try:
         r = _launch(tmp_path, body,
@@ -170,3 +176,7 @@ def test_two_process_rendezvous_and_collective(tmp_path):
     # broadcast from rank1 (20.0) must overwrite rank0's 10.0
     assert "BCAST 0 20.0" in out and "BCAST 1 20.0" in out
     assert "GATHER 0 [0.0, 1.0]" in out and "GATHER 1 [0.0, 1.0]" in out
+    # PROD elementwise across ranks: [2,3] * [3,4] = [6, 12] (shape kept)
+    assert "PROD 0 [6.0, 12.0]" in out and "PROD 1 [6.0, 12.0]" in out
+    # AVG: (4 + 8) / 2
+    assert "AVG 0 6.0" in out and "AVG 1 6.0" in out
