@@ -426,3 +426,74 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return out.reshape(n, c, fx.shape[1], fx.shape[2]).astype(feat.dtype)
 
     return apply(fn, x, grid, op_name="grid_sample")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """paddle.nn.functional.pairwise_distance: p-norm of (x - y + eps)."""
+    def fn(a, b):
+        d = jnp.abs(a - b + epsilon)
+        if jnp.isinf(p):
+            out = jnp.max(d, axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.power(jnp.sum(jnp.power(d, p), axis=-1,
+                                    keepdims=keepdim), 1.0 / p)
+        return out
+
+    return apply(fn, ensure_tensor(x), ensure_tensor(y),
+                 op_name="pairwise_distance")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """paddle.nn.functional.sequence_mask: lengths → (…, maxlen) mask."""
+    from ...core.dtype import to_jax_dtype
+
+    x = ensure_tensor(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x._value)) if x._value.size else 0
+    jdt = to_jax_dtype(dtype)
+
+    def fn(v):
+        pos = jnp.arange(int(maxlen), dtype=v.dtype)
+        return (pos < v[..., None]).astype(jdt)
+
+    return apply(fn, x, op_name="sequence_mask")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """paddle.nn.functional.zeropad2d: [left, right, top, bottom]."""
+    pl_, pr, pt, pb = (int(v) for v in padding)
+
+    def fn(v):
+        if data_format == "NCHW":
+            cfg = ((0, 0), (0, 0), (pt, pb), (pl_, pr))
+        else:  # NHWC
+            cfg = ((0, 0), (pt, pb), (pl_, pr), (0, 0))
+        return jnp.pad(v, cfg)
+
+    return apply(fn, ensure_tensor(x), op_name="zeropad2d")
+
+
+def gather_tree(ids, parents, name=None):
+    """paddle.nn.functional.gather_tree: back-trace beam-search parent
+    pointers. ids/parents: (T, B, W) → full sequences (T, B, W)."""
+    def fn(idv, par):
+        t = idv.shape[0]
+
+        def body(carry, xs):
+            beam = carry  # (B, W) beam index selected at step t+1
+            ids_t, par_t = xs
+            tok = jnp.take_along_axis(ids_t, beam, axis=1)
+            prev = jnp.take_along_axis(par_t, beam, axis=1)
+            return prev.astype(beam.dtype), tok
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2], dtype=idv.dtype), idv.shape[1:])
+        _, toks = jax.lax.scan(
+            body, init, (idv[::-1], par[::-1]))
+        return toks[::-1]
+
+    return apply(fn, ensure_tensor(ids), ensure_tensor(parents),
+                 op_name="gather_tree")
+
+
+__all__ += ["pairwise_distance", "sequence_mask", "zeropad2d", "gather_tree"]

@@ -199,3 +199,69 @@ __all__ = [
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
     "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
 ]
+
+
+def _unpool_out_size(in_size, kernel, stride, padding, output_size, dims,
+                     lead_shape):
+    if output_size is not None:
+        out = list(output_size)[-dims:]
+        return [int(v) for v in out]
+    return [
+        (in_size[i] - 1) * stride[i] - 2 * padding[i] + kernel[i]
+        for i in range(dims)
+    ]
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """paddle.nn.functional.max_unpool2d: scatter pooled values back to
+    the positions recorded by max_pool2d(return_mask=True) (flat
+    per-plane indices, paddle convention)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW")
+    x = ensure_tensor(x)
+    idx = ensure_tensor(indices)
+    ks = _tuplize(kernel_size, 2)
+    st = _tuplize(stride if stride is not None else kernel_size, 2)
+    pd = _tuplize(padding, 2)
+
+    def fn(v, iv):
+        n, c, h, w = v.shape
+        ho, wo = _unpool_out_size((h, w), ks, st, pd, output_size, 2,
+                                  v.shape[:2])
+        flat_v = v.reshape(n * c, h * w)
+        flat_i = iv.reshape(n * c, h * w).astype(jnp.int32)
+        rows = jnp.arange(n * c)[:, None]
+        out = jnp.zeros((n * c, ho * wo), v.dtype)
+        out = out.at[rows, flat_i].set(flat_v)
+        return out.reshape(n, c, ho, wo)
+
+    return apply(fn, x, idx, op_name="max_unpool2d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """paddle.nn.functional.max_unpool1d (via the 2d kernel)."""
+    if data_format != "NCL":
+        raise ValueError("max_unpool1d supports NCL")
+    x = ensure_tensor(x)
+    idx = ensure_tensor(indices)
+    ks = _tuplize(kernel_size, 1)
+    st = _tuplize(stride if stride is not None else kernel_size, 1)
+    pd = _tuplize(padding, 1)
+
+    def fn(v, iv):
+        n, c, ln = v.shape
+        (lo,) = _unpool_out_size((ln,), ks, st, pd, output_size, 1,
+                                 v.shape[:2])
+        flat_v = v.reshape(n * c, ln)
+        flat_i = iv.reshape(n * c, ln).astype(jnp.int32)
+        rows = jnp.arange(n * c)[:, None]
+        out = jnp.zeros((n * c, lo), v.dtype)
+        out = out.at[rows, flat_i].set(flat_v)
+        return out.reshape(n, c, lo)
+
+    return apply(fn, x, idx, op_name="max_unpool1d")
+
+
+__all__ += ["max_unpool1d", "max_unpool2d"]

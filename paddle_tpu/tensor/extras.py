@@ -330,3 +330,18 @@ def matrix_exp(x, name=None):
     return apply(
         jax.scipy.linalg.expm, ensure_tensor(x), op_name="matrix_exp"
     )
+
+
+def is_integer(x):
+    """paddle.is_integer: integer dtype predicate (python bool)."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.integer))
+
+
+def tolist(x):
+    """paddle.tolist: nested python lists (host sync)."""
+    return ensure_tensor(x).numpy().tolist()
+
+
+__all__ += ["is_integer", "tolist"]

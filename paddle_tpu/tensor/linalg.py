@@ -316,3 +316,29 @@ from .extras import (  # noqa: E402,F401
 )
 __all__ += ["cond", "lu_unpack", "householder_product", "matrix_exp",
             "inverse"]
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """paddle.cdist: pairwise p-norm distance between row batches.
+    x: (..., P, M), y: (..., R, M) → (..., P, R)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            # MXU path: |a-b|^2 = |a|^2 + |b|^2 - 2ab
+            a2 = jnp.sum(a * a, axis=-1, keepdims=True)
+            b2 = jnp.sum(b * b, axis=-1)[..., None, :]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2.0 * ab, 0.0))
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if jnp.isinf(p):
+            return jnp.max(diff, axis=-1)
+        return jnp.power(jnp.sum(jnp.power(diff, p), axis=-1), 1.0 / p)
+
+    return apply(fn, x, y, op_name="cdist")
+
+
+__all__ += ["cdist"]

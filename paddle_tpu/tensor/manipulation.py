@@ -571,3 +571,66 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return patches.reshape(n, c * kh * kw, -1)
 
     return apply(fn, x, op_name="unfold")
+
+
+def hstack(x, name=None):
+    """paddle.hstack: horizontal concat (axis 1, axis 0 for 1-D)."""
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.hstack(vs), *ts, op_name="hstack")
+
+
+def permute(x, *perm, name=None):
+    """paddle.permute: transpose alias (perm as varargs or a list)."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = perm[0]
+    return transpose(x, list(perm))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """paddle.tensor_split: np.array_split semantics (uneven allowed)."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if isinstance(num_or_indices, int):
+            return tuple(jnp.array_split(v, num_or_indices, axis=axis))
+        return tuple(jnp.split(v, list(num_or_indices), axis=axis))
+
+    return list(apply(fn, x, op_name="tensor_split"))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """paddle.select_scatter: write ``values`` into ``x`` at ``index``
+    along ``axis`` (the inverse of x[..., index, ...] selection)."""
+    x, values = ensure_tensor(x), ensure_tensor(values)
+
+    def fn(v, val):
+        import builtins
+
+        # NB: builtins.slice — this module defines paddle's `slice` op
+        idx = [builtins.slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+
+    return apply(fn, x, values, op_name="select_scatter")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """paddle.shard_index: recompute global ids into shard-local ids
+    (ids outside this shard become ``ignore_value``)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        lo = size * shard_id
+        hi = lo + size
+        inside = (v >= lo) & (v < hi)
+        return jnp.where(inside, v - lo, ignore_value).astype(v.dtype)
+
+    return apply(fn, ensure_tensor(input), op_name="shard_index")
+
+
+__all__ += ["hstack", "permute", "tensor_split", "select_scatter",
+            "shard_index"]

@@ -346,3 +346,154 @@ class TestSpecialFunctionTail(OpTest):
             [y, xs])
         self.check_grad(
             lambda t: paddle.cumulative_trapezoid(t, dx=0.25), [y])
+
+
+class TestRound3SurfaceTail(OpTest):
+    """Round-3 breadth sweep: the last top-level + functional gaps found
+    by scanning the reference's documented public API."""
+
+    def test_cdist(self):
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(5, 3).astype("f4")
+        y = rng.randn(7, 3).astype("f4")
+        for p in (2.0, 1.0, float("inf")):
+            out = paddle.cdist(_t(x), _t(y), p=p).numpy()
+            ref = sp_cdist(x, y, "minkowski", p=p) if p != float("inf") \
+                else sp_cdist(x, y, "chebyshev")
+            # the p=2 MXU path (|a|^2+|b|^2-2ab) cancels catastrophically
+            # in f32 for nearby points — paddle/torch mm modes share this
+            tol = dict(rtol=2e-2, atol=2e-2) if p == 2.0 else dict(
+                rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(out, ref.astype("f4"), **tol)
+        # the direct (non-mm) euclid path is exact
+        out = paddle.cdist(_t(x), _t(y), p=2.0,
+                           compute_mode="donot_use_mm_for_euclid_dist").numpy()
+        np.testing.assert_allclose(out, sp_cdist(x, y).astype("f4"),
+                                   rtol=1e-4, atol=1e-5)
+        self.grad_rtol = 5e-2  # f32 sqrt curvature vs fd eps
+        self.check_grad(
+            lambda t: paddle.cdist(
+                t, _t(y),
+                compute_mode="donot_use_mm_for_euclid_dist").sum(), [x])
+
+    def test_hstack_permute_tensor_split(self):
+        a = np.arange(6, dtype="f4").reshape(2, 3)
+        b = np.arange(4, dtype="f4").reshape(2, 2)
+        np.testing.assert_array_equal(
+            paddle.hstack([_t(a), _t(b)]).numpy(), np.hstack([a, b]))
+        x = np.arange(24, dtype="f4").reshape(2, 3, 4)
+        np.testing.assert_array_equal(
+            paddle.permute(_t(x), 2, 0, 1).numpy(), x.transpose(2, 0, 1))
+        parts = paddle.tensor_split(_t(np.arange(7, dtype="f4")), 3)
+        ref = np.array_split(np.arange(7, dtype="f4"), 3)
+        assert len(parts) == 3
+        for p, r in zip(parts, ref):
+            np.testing.assert_array_equal(p.numpy(), r)
+
+    def test_select_scatter_shard_index(self):
+        x = np.zeros((3, 4), "f4")
+        vals = np.ones(4, "f4") * 7
+        out = paddle.select_scatter(_t(x), _t(vals), axis=0, index=1).numpy()
+        ref = x.copy(); ref[1] = 7
+        np.testing.assert_array_equal(out, ref)
+
+        ids = np.asarray([[1], [5], [9], [14]], "i8")
+        out = paddle.shard_index(_t(ids), index_num=16, nshards=2,
+                                 shard_id=0).numpy()
+        np.testing.assert_array_equal(out, [[1], [5], [-1], [-1]])
+        out = paddle.shard_index(_t(ids), index_num=16, nshards=2,
+                                 shard_id=1).numpy()
+        np.testing.assert_array_equal(out, [[-1], [-1], [1], [6]])
+
+    def test_is_integer_tolist(self):
+        assert paddle.is_integer(_t(np.zeros(2, "i4")))
+        assert not paddle.is_integer(_t(np.zeros(2, "f4")))
+        assert paddle.tolist(_t(np.asarray([[1., 2.], [3., 4.]]))) == \
+            [[1.0, 2.0], [3.0, 4.0]]
+
+    def test_loss_tail(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(1)
+        # dice: prob (N, L, C), label (N, L, 1)
+        probs = rng.dirichlet(np.ones(3), size=(2, 5)).astype("f4")
+        lab = rng.randint(0, 3, (2, 5, 1))
+        d = float(F.dice_loss(_t(probs), _t(lab)).numpy())
+        assert 0.0 < d < 1.0
+        # log_loss vs manual
+        p = rng.uniform(0.05, 0.95, (4, 1)).astype("f4")
+        y = rng.randint(0, 2, (4, 1)).astype("f4")
+        out = F.log_loss(_t(p), _t(y)).numpy()
+        ref = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        # pairwise distance vs numpy
+        a, b = rng.randn(4, 8).astype("f4"), rng.randn(4, 8).astype("f4")
+        out = F.pairwise_distance(_t(a), _t(b)).numpy()
+        ref = np.linalg.norm(a - b + 1e-6, axis=-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        # npair finite and positive-ish
+        lbl = np.asarray([0, 1, 0, 1])
+        v = float(F.npair_loss(_t(a), _t(b), _t(lbl)).numpy())
+        assert np.isfinite(v)
+        # triplet with custom distance == builtin for euclid
+        n = rng.randn(4, 8).astype("f4")
+        t1 = float(F.triplet_margin_with_distance_loss(
+            _t(a), _t(b), _t(n)).numpy())
+        t2 = float(F.triplet_margin_with_distance_loss(
+            _t(a), _t(b), _t(n),
+            distance_function=lambda u, v_: ((u - v_) ** 2).sum(-1).sqrt(),
+        ).numpy())
+        np.testing.assert_allclose(t1, t2, rtol=1e-4)
+
+    def test_margin_cross_entropy(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(2)
+        # cosine logits in [-1, 1]
+        feats = rng.randn(6, 16).astype("f4")
+        feats /= np.linalg.norm(feats, axis=1, keepdims=True)
+        w = rng.randn(16, 10).astype("f4")
+        w /= np.linalg.norm(w, axis=0, keepdims=True)
+        cos = feats @ w
+        lab = rng.randint(0, 10, (6,))
+        loss, sm = F.margin_cross_entropy(
+            _t(cos), _t(lab), return_softmax=True, reduction="mean")
+        assert np.isfinite(float(loss.numpy()))
+        np.testing.assert_allclose(sm.numpy().sum(1), np.ones(6), rtol=1e-5)
+        # margin must increase the loss vs plain scaled CE
+        plain, _ = F.margin_cross_entropy(
+            _t(cos), _t(lab), margin1=1.0, margin2=0.0, margin3=0.0,
+            return_softmax=True)
+        assert float(loss.numpy()) >= float(plain.numpy())
+
+    def test_max_unpool_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 8, 8).astype("f4")
+        pooled, idx = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+        restored = F.max_unpool2d(pooled, idx, 2, stride=2).numpy()
+        assert restored.shape == x.shape
+        # every pooled max value lands back at its argmax position
+        pv = pooled.numpy()
+        assert np.count_nonzero(restored) <= pv.size
+        np.testing.assert_allclose(np.sort(restored[restored != 0]),
+                                   np.sort(pv[pv != 0]), rtol=1e-6)
+
+    def test_sequence_mask_zeropad_gather_tree(self):
+        import paddle_tpu.nn.functional as F
+
+        m = F.sequence_mask(_t(np.asarray([2, 0, 3])), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            m, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+        z = F.zeropad2d(_t(np.ones((1, 1, 2, 2), "f4")), [1, 0, 0, 2]).numpy()
+        assert z.shape == (1, 1, 4, 3) and z.sum() == 4.0
+        # beam back-trace: T=3, B=1, W=2
+        ids = np.asarray([[[10, 11]], [[20, 21]], [[30, 31]]], "i4")
+        parents = np.asarray([[[0, 0]], [[1, 0]], [[0, 1]]], "i4")
+        out = F.gather_tree(_t(ids), _t(parents)).numpy()
+        # beam0 at T: parent chain 0<-... : final beam0 token 30, its
+        # parent at t2 is 0 -> token 20 at t1 whose parent is 1 -> 11
+        np.testing.assert_array_equal(out[:, 0, 0], [11, 20, 30])
