@@ -22,7 +22,7 @@ from .communication.group import Group, new_group, get_group, is_initialized  # 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "all_reduce", "all_gather", "all_gather_object", "broadcast", "reduce",
-    "scatter", "barrier", "all_to_all", "send", "recv", "ReduceOp",
+    "scatter", "gather", "barrier", "all_to_all", "send", "recv", "ReduceOp",
     "new_group", "get_group", "is_initialized", "spawn", "launch",
     "get_backend", "DataParallel", "fleet", "split", "shard_tensor",
 ]
@@ -163,8 +163,28 @@ def _world_mesh_one_dev_per_proc():
 import functools as _functools
 
 
+_backend_seen = (None, 0)
+
+
+def _backend_token():
+    """Monotonic token for the live XLA backend. clear_backends() (which
+    the multichip dryrun performs) invalidates every Device handle a
+    cached compiled collective closed over; on backend change the stale
+    cache is dropped outright (no id()-reuse hazard, no pinned dead
+    executables) and the token keys the fresh generation."""
+    global _backend_seen
+    import jax.extend.backend as _xb
+
+    backend = _xb.get_backend()
+    last, token = _backend_seen
+    if backend is not last:
+        _collective_fn.cache_clear()
+        _backend_seen = (backend, token + 1)
+    return _backend_seen[1]
+
+
 @_functools.lru_cache(maxsize=256)
-def _collective_fn(op_name, shape, dtype_str, n):
+def _collective_fn(op_name, shape, dtype_str, n, backend_token):
     """Compiled cross-process reduction, cached per (op, shape, dtype) —
     eager collectives in a training loop must not retrace every call."""
     import jax.numpy as jnp
@@ -213,7 +233,8 @@ def _cross_process_collective(value, op_name):
     value = jnp.asarray(value)
     n_proc = len({d.process_index for d in jax.devices()})
     fn, mesh = _collective_fn(
-        op_name, tuple(value.shape), str(value.dtype), n_proc)
+        op_name, tuple(value.shape), str(value.dtype), n_proc,
+        _backend_token())
     my_dev = mesh.devices.flat[jax.process_index()]
     local = jax.device_put(value[None], my_dev)
     garr = jax.make_array_from_single_device_arrays(
@@ -252,7 +273,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     if jax.process_count() > 1:
         t = _ensure_tensor(tensor)
-        t._value = _cross_process_collective(t._value, _op_name(op))
+        # every rank participates in the collective, but only dst keeps
+        # the reduced value — non-dst ranks retain their original tensor
+        # (reference reduce only updates dst)
+        reduced = _cross_process_collective(t._value, _op_name(op))
+        if jax.process_index() == int(dst):
+            t._value = reduced
         return _maybe_task(t, sync_op)
     return _maybe_task(tensor, sync_op)
 
@@ -297,6 +323,29 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def all_gather_object(object_list, obj, group=None):
+    if jax.process_count() > 1:
+        import pickle
+
+        import jax.numpy as jnp
+
+        # fixed-shape protocol over the array substrate: gather byte
+        # lengths first (every rank then knows the common pad width),
+        # pad pickled payloads to max, gather, slice+unpickle per row
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        lengths = _cross_process_collective(
+            jnp.asarray([payload.size], jnp.int32), "gather")
+        lengths = np.asarray(lengths).reshape(-1)
+        pad = int(lengths.max())
+        padded = np.zeros((pad,), np.uint8)
+        padded[: payload.size] = payload
+        rows = np.asarray(
+            _cross_process_collective(jnp.asarray(padded), "gather"))
+        del object_list[:]
+        object_list.extend(
+            pickle.loads(rows[i, : lengths[i]].tobytes())
+            for i in range(rows.shape[0])
+        )
+        return object_list
     n = max(get_world_size(group), 1)
     del object_list[:]
     object_list.extend(obj for _ in range(n))
@@ -304,12 +353,81 @@ def all_gather_object(object_list, obj, group=None):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if jax.process_count() > 1:
+        import jax.numpy as jnp
+
+        t = _ensure_tensor(tensor)
+        n = jax.process_count()
+        # broadcast src's stacked list (zeros-contribution sum trick,
+        # same as broadcast()), then each rank keeps its own row.
+        # Non-src ranks may pass tensor_list=None; tensor's shape/dtype
+        # define the slot (reference scatter contract).
+        if jax.process_index() == int(src):
+            if tensor_list is None or len(tensor_list) != n:
+                raise ValueError(
+                    f"scatter: src rank must pass tensor_list of length "
+                    f"{n}, got {None if tensor_list is None else len(tensor_list)}"
+                )
+            rows = [jnp.asarray(_ensure_tensor(x)._value)
+                    for x in tensor_list]
+            # every rank's compiled collective is keyed on tensor's
+            # shape/dtype; a mismatched src list must fail loudly here,
+            # not deadlock the other ranks on a divergent program
+            for i, r in enumerate(rows):
+                if r.shape != tuple(t.shape):
+                    raise ValueError(
+                        f"scatter: tensor_list[{i}] shape {r.shape} != "
+                        f"receive tensor shape {tuple(t.shape)}"
+                    )
+            contrib = jnp.stack(rows).astype(t._value.dtype)
+        else:
+            contrib = jnp.zeros((n, *t.shape), t._value.dtype)
+        stacked = _cross_process_collective(contrib, "sum")
+        t._value = stacked[jax.process_index()]
+        return _maybe_task(t, sync_op)
     if tensor_list:
         tensor.set_value(tensor_list[get_rank(group)])
     return _maybe_task(tensor, sync_op)
 
 
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather every rank's tensor into ``gather_list`` on rank ``dst``
+    (reference: paddle.distributed.gather). Non-dst ranks' lists are
+    left untouched; all ranks must participate in the collective."""
+    t = _ensure_tensor(tensor)
+    if jax.process_count() > 1:
+        stacked = _cross_process_collective(t._value, "gather")
+        if jax.process_index() == int(dst) and gather_list is not None:
+            del gather_list[:]
+            gather_list.extend(
+                Tensor(stacked[i]) for i in range(stacked.shape[0]))
+        return _maybe_task(gather_list, sync_op)
+    if gather_list is not None and get_rank(group) == int(dst):
+        n = max(get_world_size(group), 1)
+        del gather_list[:]
+        gather_list.extend(Tensor(t._value) for _ in range(n))
+    return _maybe_task(gather_list, sync_op)
+
+
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    if jax.process_count() > 1:
+        import jax.numpy as jnp
+
+        n = jax.process_count()
+        if len(in_tensor_list) != n:
+            raise ValueError(
+                f"all_to_all: in_tensor_list must have world_size={n} "
+                f"entries, got {len(in_tensor_list)}"
+            )
+        # gather every rank's stacked outbox, then row p of my inbox is
+        # rank p's slot for me: out[p] = (rank p's in_tensor_list)[me]
+        stacked = jnp.stack(
+            [jnp.asarray(_ensure_tensor(x)._value) for x in in_tensor_list])
+        gathered = _cross_process_collective(stacked, "gather")
+        me = jax.process_index()
+        del out_tensor_list[:]
+        out_tensor_list.extend(Tensor(gathered[p, me]) for p in range(n))
+        return _maybe_task(out_tensor_list, sync_op)
     del out_tensor_list[:]
     out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
     return _maybe_task(out_tensor_list, sync_op)

@@ -41,15 +41,16 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        # The vocab-sharded gather's partial sums all-reduce to a
-        # replicated hidden state (Megatron semantics) — a replicated
-        # constraint, NOT E-over-mp: an E-sharded hidden colliding with a
-        # downstream (dp, sep)-sharded constraint makes GSPMD fall back
-        # to replicate-then-repartition (full remat). This applies to any
-        # lookup rank — the output's last dim is always embedding_dim
-        # (mp-sharded logits come from the lm matmul, never from here).
+        # The vocab-sharded gather's partial sums all-reduce to a hidden
+        # state whose LAST dim must be replicated (Megatron semantics),
+        # NOT E-over-mp: an E-sharded hidden colliding with a downstream
+        # (dp, sep)-sharded constraint makes GSPMD fall back to
+        # replicate-then-repartition (full remat). Leading (batch/seq)
+        # dims stay UNCONSTRAINED so a dp/sep-sharded batch keeps its
+        # sharding instead of paying a batch-dim all-gather here.
         return apply(
-            lambda v: mesh_state.constraint(v, *([None] * v.ndim)),
+            lambda v: mesh_state.constraint(
+                v, *([mesh_state.UNCONSTRAINED] * (v.ndim - 1)), None),
             out, op_name="vocab_parallel_gather",
         )
 

@@ -312,44 +312,66 @@ def _claim_worker_id(claim_dir):
     while True:
         slot = os.path.join(claim_dir, f"w{i}")
         try:
-            fd = os.open(slot, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.write(fd, str(os.getpid()).encode())
-            os.close(fd)
+            return _try_claim_slot(slot, i)
+        except FileNotFoundError:
+            # claim_dir removed by close() while this worker was still
+            # spawning (anywhere in the claim/reap sequence): the pool is
+            # shutting down, nothing will consume our output — any id is
+            # fine, exit the claim loop quietly
             return i
-        except FileExistsError:
-            # dead claimant? take over via an exclusive reap marker so
-            # only one respawned worker recycles the slot
-            try:
-                with open(slot) as f:
-                    owner = int(f.read().strip() or -1)
-            except (OSError, ValueError):
-                owner = -1
-            if owner != -1 and not _pid_alive(owner):
-                try:
-                    rfd = os.open(
-                        slot + ".reap", os.O_CREAT | os.O_EXCL | os.O_WRONLY
-                    )
-                except FileExistsError:
-                    i += 1
-                    continue
-                try:
-                    # re-check under the marker: another reaper may have
-                    # recycled this slot between our read and the win
-                    try:
-                        with open(slot) as f:
-                            owner = int(f.read().strip() or -1)
-                    except (OSError, ValueError):
-                        owner = -1
-                    if owner == -1 or _pid_alive(owner):
-                        i += 1
-                        continue
-                    with open(slot, "w") as f:
-                        f.write(str(os.getpid()))
-                    return i
-                finally:
-                    os.close(rfd)
-                    os.unlink(slot + ".reap")
+        except _SlotTaken:
             i += 1
+
+
+class _SlotTaken(Exception):
+    """Internal: this slot is live-owned, try the next one."""
+
+
+def _try_claim_slot(slot, i):
+    try:
+        fd = os.open(slot, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, str(os.getpid()).encode())
+        os.close(fd)
+        return i
+    except FileExistsError:
+        # dead claimant? take over via an exclusive reap marker so
+        # only one respawned worker recycles the slot
+        try:
+            with open(slot) as f:
+                owner = int(f.read().strip() or -1)
+        except FileNotFoundError:
+            raise  # claim_dir gone: let the caller exit quietly
+        except (OSError, ValueError):
+            owner = -1
+        if owner != -1 and not _pid_alive(owner):
+            try:
+                rfd = os.open(
+                    slot + ".reap", os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                )
+            except FileExistsError:
+                raise _SlotTaken from None
+            try:
+                # re-check under the marker: another reaper may have
+                # recycled this slot between our read and the win
+                try:
+                    with open(slot) as f:
+                        owner = int(f.read().strip() or -1)
+                except FileNotFoundError:
+                    raise
+                except (OSError, ValueError):
+                    owner = -1
+                if owner == -1 or _pid_alive(owner):
+                    raise _SlotTaken from None
+                with open(slot, "w") as f:
+                    f.write(str(os.getpid()))
+                return i
+            finally:
+                os.close(rfd)
+                try:
+                    os.unlink(slot + ".reap")
+                except FileNotFoundError:
+                    pass
+        raise _SlotTaken from None
 
 
 def _pool_init(dataset, collate_fn, worker_init_fn, claim_dir, num_workers):

@@ -449,6 +449,12 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
     x = ensure_tensor(x)
     if maxlen is None:
+        if isinstance(x._value, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) needs the max length as a "
+                "host value, which is unavailable while tracing "
+                "(to_static/jit). Pass an explicit maxlen."
+            )
         maxlen = int(jnp.max(x._value)) if x._value.size else 0
     jdt = to_jax_dtype(dtype)
 

@@ -53,6 +53,11 @@ class MeshScope:
         return False
 
 
+# pass-through marker for constraint(): "leave this dim's sharding to the
+# propagation pass" (valid only under a trace; eager constraint is identity)
+UNCONSTRAINED = PartitionSpec.UNCONSTRAINED
+
+
 def _named_sharding(spec):
     if _GLOBAL_MESH is None:
         return None
@@ -63,6 +68,8 @@ def _named_sharding(spec):
     for entry in spec:
         if entry is None:
             cleaned.append(None)
+        elif entry is PartitionSpec.UNCONSTRAINED:
+            cleaned.append(entry)
         elif isinstance(entry, (tuple, list)):
             kept = tuple(a for a in entry if a in _GLOBAL_MESH.shape)
             cleaned.append(kept if kept else None)
@@ -77,7 +84,7 @@ def _divisible(value, spec):
         return False
     shape = np.shape(value)
     for dim, entry in enumerate(spec):
-        if entry is None:
+        if entry is None or entry is PartitionSpec.UNCONSTRAINED:
             continue
         axes = entry if isinstance(entry, (tuple, list)) else (entry,)
         size = 1
@@ -114,6 +121,10 @@ def constraint(value, *spec):
         return value
     if isinstance(value, jax.core.Tracer):
         return jax.lax.with_sharding_constraint(value, sharding)
+    if any(e is PartitionSpec.UNCONSTRAINED for e in spec):
+        # UNCONSTRAINED is a propagation-pass concept; a concrete array
+        # already carries its sharding — nothing to do eagerly
+        return value
     if not _divisible(value, tuple(spec)):
         return value
     return jax.device_put(value, sharding)

@@ -162,6 +162,34 @@ def test_two_process_rendezvous_and_collective(tmp_path):
         "a = paddle.to_tensor(np.asarray([float((rank + 1) * 4)], 'f4'))\n"
         "dist.all_reduce(a, op=dist.ReduceOp.AVG)\n"
         "print('AVG', rank, float(np.asarray(a._value)[0]))\n"
+        # reduce: only dst=1 keeps the sum; rank0 keeps its original
+        "r = paddle.to_tensor(np.asarray([float(rank + 1)], 'f4'))\n"
+        "dist.reduce(r, dst=1)\n"
+        "print('REDUCE', rank, float(np.asarray(r._value)[0]))\n"
+        # all_to_all: rank q's out[p] = rank p's in[q]
+        "ins = [paddle.to_tensor(np.asarray([float(10 * rank + p)], 'f4'))\n"
+        "       for p in range(2)]\n"
+        "outs2 = []\n"
+        "dist.all_to_all(outs2, ins)\n"
+        "print('A2A', rank,"
+        " [float(np.asarray(t._value)[0]) for t in outs2])\n"
+        # scatter from src=0: rank p receives tensor_list[p]
+        "s = paddle.to_tensor(np.asarray([0.0], 'f4'))\n"
+        "sl = ([paddle.to_tensor(np.asarray([float(100 + p)], 'f4'))\n"
+        "       for p in range(2)] if rank == 0 else None)\n"
+        "dist.scatter(s, sl, src=0)\n"
+        "print('SCATTER', rank, float(np.asarray(s._value)[0]))\n"
+        # gather to dst=1: only rank1's list is filled
+        "gl = []\n"
+        "gt = paddle.to_tensor(np.asarray([float(7 * (rank + 1))], 'f4'))\n"
+        "dist.gather(gt, gl, dst=1)\n"
+        "print('GATHERDST', rank,"
+        " [float(np.asarray(t._value)[0]) for t in gl])\n"
+        # all_gather_object: arbitrary picklables of unequal size
+        "objs = []\n"
+        "dist.all_gather_object(objs, {'rank': rank, 'pad': 'x' * (rank * 50)})\n"
+        "print('OBJ', rank, [o['rank'] for o in objs],"
+        " [len(o['pad']) for o in objs])\n"
     )
     try:
         r = _launch(tmp_path, body,
@@ -180,3 +208,15 @@ def test_two_process_rendezvous_and_collective(tmp_path):
     assert "PROD 0 [6.0, 12.0]" in out and "PROD 1 [6.0, 12.0]" in out
     # AVG: (4 + 8) / 2
     assert "AVG 0 6.0" in out and "AVG 1 6.0" in out
+    # reduce dst=1: rank0 keeps its original 1.0, rank1 gets 1+2=3
+    assert "REDUCE 0 1.0" in out and "REDUCE 1 3.0" in out
+    # all_to_all: rank0 in=[0,1] rank1 in=[10,11] → rank0 out=[0,10],
+    # rank1 out=[1,11]
+    assert "A2A 0 [0.0, 10.0]" in out and "A2A 1 [1.0, 11.0]" in out
+    # scatter from rank0's [100, 101]
+    assert "SCATTER 0 100.0" in out and "SCATTER 1 101.0" in out
+    # gather to dst=1: rank0's list stays empty
+    assert "GATHERDST 0 []" in out
+    assert "GATHERDST 1 [7.0, 14.0]" in out
+    # all_gather_object with unequal pickled sizes
+    assert "OBJ 0 [0, 1] [0, 50]" in out and "OBJ 1 [0, 1] [0, 50]" in out
