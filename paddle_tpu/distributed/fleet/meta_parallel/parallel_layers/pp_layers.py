@@ -97,7 +97,9 @@ class PipelineLayer(Layer):
         # shared across stages (tied embeddings) is placed once, on its
         # FIRST owning stage — later stages reach it through the
         # inter-stage transfer, like the reference's shared-weight
-        # broadcast group
+        # broadcast group. A param that is already mesh-sharded (TP/ZeRO-3
+        # layers built under the global mesh) keeps its PartitionSpec,
+        # re-homed to the stage sub-mesh — PP composes with TP/sharding.
         if hcg is not None and hcg.num_stages > 1:
             placed: set[int] = set()
             for chunk, (lo, hi) in enumerate(self._segment):
@@ -107,7 +109,15 @@ class PipelineLayer(Layer):
                         placed.add(id(item))
                         with MeshScope(mesh):
                             for _, p in item.named_parameters():
-                                p._value = mesh_state.replicate_value(p._value)
+                                spec = getattr(
+                                    getattr(p._value, "sharding", None),
+                                    "spec", None)
+                                if spec:
+                                    p._value = mesh_state.shard_value(
+                                        p._value, *spec)
+                                else:
+                                    p._value = mesh_state.replicate_value(
+                                        p._value)
 
     def _segment_layers(self, built, num_stages, seg_method):
         n = len(built)

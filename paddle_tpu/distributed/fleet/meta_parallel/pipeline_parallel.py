@@ -79,10 +79,17 @@ class _JitPipelineEngine:
                     if self._multi else None)
             last = c == n - 1
 
-            def make_fwd(mod_, with_loss):
+            def make_fwd(mod_, with_loss, mesh_):
+                import contextlib
+
                 def fwd_pure(p_vals, x, *rest):
                     rng = rest[-1]
-                    with autograd.no_grad(), traced_key_scope(rng):
+                    # trace under the chunk's OWN stage mesh so TP/SP
+                    # sharding constraints inside mp layers bind to the
+                    # stage sub-mesh, not the global (stage-0) mesh
+                    scope = (MeshScope(mesh_) if mesh_ is not None
+                             else contextlib.nullcontext())
+                    with scope, autograd.no_grad(), traced_key_scope(rng):
                         out_t, _ = functional_call(
                             mod_, mod_.forward,
                             [Tensor(x, stop_gradient=True)], {}, p_vals, [])
@@ -94,7 +101,7 @@ class _JitPipelineEngine:
 
                 return fwd_pure
 
-            fwd_pure = make_fwd(mod, last)
+            fwd_pure = make_fwd(mod, last, mesh)
 
             if last:
                 def make_last(fwd_pure_):

@@ -28,7 +28,17 @@ __all__ = [
 ]
 
 
-def _shard_dim0(value):
+def _shard_dim0(value, like=None):
+    """Shard dim 0 over the ``sharding`` axis of the mesh that owns
+    ``like`` (the param), falling back to the global mesh. Under PP a
+    stage-1 param lives on a stage sub-mesh; its optimizer state must be
+    co-located there, not on the global (stage-0) mesh."""
+    mesh = getattr(getattr(like, "sharding", None), "mesh", None)
+    if mesh is not None and "sharding" in getattr(mesh, "shape", {}):
+        from .....parallel.mesh import MeshScope
+
+        with MeshScope(mesh):
+            return mesh_state.shard_value(value, "sharding")
     return mesh_state.shard_value(value, "sharding")
 
 
@@ -38,7 +48,7 @@ def _patch_optimizer_state_sharding(optimizer):
 
     def sharded_init(p_value):
         st = orig_init(p_value)
-        return {k: _shard_dim0(v) for k, v in st.items()}
+        return {k: _shard_dim0(v, like=p_value) for k, v in st.items()}
 
     optimizer._init_state = sharded_init
     # master weights are created in _state_for; shard those too
@@ -47,7 +57,8 @@ def _patch_optimizer_state_sharding(optimizer):
     def state_for(param):
         st = orig_state_for(param)
         if "master" in st:
-            target = _shard_dim0(st["master"])
+            like = getattr(param, "_value", None)
+            target = _shard_dim0(st["master"], like=like)
             if getattr(st["master"], "sharding", None) != getattr(
                 target, "sharding", None
             ):
@@ -95,7 +106,7 @@ class GroupShardedStage3(_ShardedModelWrapper):
                  segment_size=2**20, **kwargs):
         super().__init__(layer)
         for _, p in layer.named_parameters():
-            p._value = _shard_dim0(p._value)
+            p._value = _shard_dim0(p._value, like=p._value)
             p.is_sharded = True
 
     def get_all_parameters(self):

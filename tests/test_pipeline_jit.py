@@ -131,3 +131,94 @@ def test_pp_amp_scaler_path():
     y = paddle.to_tensor(np.arange(4) % 8)
     loss = model.train_batch([x, y], opt, scaler=scaler)
     assert np.isfinite(float(loss))
+
+
+def _tp_descs():
+    from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear,
+    )
+
+    return [
+        LayerDesc(ColumnParallelLinear, 16, 32, gather_output=False),
+        LayerDesc(nn.ReLU),
+        LayerDesc(RowParallelLinear, 32, 32, input_is_parallel=True),
+        LayerDesc(nn.ReLU),
+        LayerDesc(ColumnParallelLinear, 32, 32, gather_output=True),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, 32, 8),
+    ]
+
+
+def test_pp_tp_zero_three_axis_matches_serial():
+    """The north-star topology (BASELINE config #3): PP x TP x ZeRO-2
+    composed on one 8-device mesh — pp2 stages whose sub-meshes carry
+    mp=2 and sharding=2. Oracle: multi-step losses == mesh-less serial.
+    Also asserts the composition is REAL: TP params live mp-sharded on
+    their stage sub-mesh and optimizer moments are sharded over the
+    sharding axis of the param's own mesh."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+
+    x_np = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    y_np = (np.arange(8) % 8).astype(np.int64)
+
+    # serial oracle: same descs (mp layers degrade mesh-less), AdamW
+    mesh_state.set_mesh(None)
+    paddle.seed(7)
+    net = nn.Sequential(*[d.build_layer() for d in _tp_descs()])
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters(),
+                                 weight_decay=0.01)
+    ref = []
+    for _ in range(3):
+        loss = loss_fn(net(paddle.to_tensor(x_np)), paddle.to_tensor(y_np))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ref.append(float(loss))
+
+    mesh_state.set_mesh(None)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+        "sharding_degree": 2,
+    }
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(7)
+    pipe = PipelineLayer(layers=_tp_descs(), num_stages=2,
+                         loss_fn=nn.CrossEntropyLoss())
+    model = fleet.distributed_model(pipe)
+    assert isinstance(model, PipelineParallel)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters(),
+                                 weight_decay=0.01)
+    opt = fleet.distributed_optimizer(opt)
+    losses = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # fallback = failure
+        for _ in range(3):
+            loss = model.train_batch(
+                [paddle.to_tensor(x_np), paddle.to_tensor(y_np)], opt)
+            losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)
+
+    hcg = fleet.get_hybrid_communicate_group()
+    stage_meshes = [hcg.get_stage_mesh(s) for s in range(2)]
+    # stage-1's first TP weight: mp-sharded, homed on stage-1's devices
+    items1 = pipe.get_stage_items(1)
+    tp1 = next(it for it in items1 if hasattr(it, "weight")
+               and getattr(it.weight, "is_distributed", False))
+    sh = tp1.weight._value.sharding
+    assert sh.mesh.devices.tolist() == stage_meshes[1].devices.tolist()
+    assert "mp" in [a for e in sh.spec if e is not None
+                    for a in ((e,) if isinstance(e, str) else e)]
+    # its moment state is sharded over the sharding axis of the SAME mesh
+    st = opt._state_for(tp1.weight)
+    msh = st["moment1"].sharding
+    assert msh.mesh.devices.tolist() == stage_meshes[1].devices.tolist()
+    assert any("sharding" in ((e,) if isinstance(e, str) else tuple(e or ()))
+               for e in msh.spec if e is not None)
