@@ -63,17 +63,21 @@ def main():
 
     on_tpu = backend == "tpu"
     if on_tpu:
-        # ~941M-param Llama block; measured config sweep on one v5e-16G
-        # (bench notes): bf16 params + f32 master + bf16 Adam moments
-        # frees enough HBM to train WITHOUT activation recompute, which
-        # beats every remat variant (46.8% vs 39.0% full-remat MFU)
+        # END-TO-END training at Llama-2-7B dimensions (BASELINE config
+        # #3: h4096/d128/inter11008/vocab32000) — L=4 layers of exactly
+        # the 7B shape fit one v5e-16G (~1.07B params; bf16 params + f32
+        # master + bf16 Adam moments). Measured sweep (round 4,
+        # BENCH_NOTES): B1 S4096 no-remat 70.1% MFU beats B2 (61.6%,
+        # HBM pressure) and B2+attn-remat (61.5%). The earlier 941M
+        # h2048 headline (47.7%, shape-bound at d=64) lives on as a
+        # bench_suite row.
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-            num_hidden_layers=16, num_attention_heads=32,
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=4, num_attention_heads=32,
             max_position_embeddings=4096, tensor_parallel=False,
             use_recompute=False,
         )
-        batch, seq, iters = 2, 2048, 3
+        batch, seq, iters = 1, 4096, 3
     else:  # CPU smoke path so the bench never hard-fails off-TPU
         cfg = LlamaConfig.tiny(tensor_parallel=False)
         batch, seq, iters = 2, 64, 2
@@ -82,34 +86,46 @@ def main():
     import paddle_tpu as paddle
 
     K = 10 if on_tpu else 2  # train steps fused into one dispatch
+    # OOM fallback ladder covers build AND first execution (compilation
+    # is lazy — activation OOM surfaces inside meter.measure, not
+    # build_step): full config → seq 2048 → attention remat.
     for attempt in range(3):
         try:
-            model, step, ids = build_step(cfg, batch, seq, moment_dtype="bfloat16" if on_tpu else "float32")
+            model, step, ids = build_step(
+                cfg, batch, seq,
+                moment_dtype="bfloat16" if on_tpu else "float32")
+            n_params = count_params(model)
+            tokens = batch * seq
+            flops = transformer_train_flops(
+                n_params, tokens, num_layers=cfg.num_hidden_layers,
+                seq_len=seq, hidden=cfg.hidden_size, causal=True,
+            )
+            log(f"params={n_params/1e6:.1f}M tokens/step={tokens} K={K} "
+                f"steps/dispatch model TFLOPs/step={flops/1e12:.2f} "
+                f"peak={peak_flops_per_chip()/1e12:.0f}")
+
+            # K different batches stacked along a leading scan dim
+            ids_stacked = paddle.to_tensor(np.random.RandomState(1).randint(
+                0, cfg.vocab_size, (K, batch, seq)))
+
+            t0 = time.perf_counter()
+            meter = MFUMeter(flops * K, tokens * K)
+            res = meter.measure(
+                lambda: step.run_steps(ids_stacked, ids_stacked),
+                warmup=1, iters=iters)
             break
-        except Exception as e:  # OOM → halve batch
-            if "RESOURCE_EXHAUSTED" not in str(e) or batch == 1:
+        except Exception as e:  # OOM → shorter sequence, then remat
+            if "RESOURCE_EXHAUSTED" not in str(e):
                 raise
-            log(f"OOM at batch={batch}; halving ({e.__class__.__name__})")
-            batch //= 2
-
-    n_params = count_params(model)
-    tokens = batch * seq
-    flops = transformer_train_flops(
-        n_params, tokens, num_layers=cfg.num_hidden_layers, seq_len=seq,
-        hidden=cfg.hidden_size, causal=True,
-    )
-    log(f"params={n_params/1e6:.1f}M tokens/step={tokens} K={K} steps/dispatch "
-        f"model TFLOPs/step={flops/1e12:.2f} peak={peak_flops_per_chip()/1e12:.0f}")
-
-    # K different batches stacked along a leading scan dim
-    ids_stacked = paddle.to_tensor(
-        np.random.RandomState(1).randint(0, cfg.vocab_size, (K, batch, seq)))
-
-    t0 = time.perf_counter()
-    meter = MFUMeter(flops * K, tokens * K)
-    res = meter.measure(
-        lambda: step.run_steps(ids_stacked, ids_stacked),
-        warmup=1, iters=iters)
+            if seq > 2048:
+                log(f"OOM at seq={seq}; halving ({e.__class__.__name__})")
+                seq //= 2
+            elif not cfg.use_recompute:
+                log("OOM; enabling attention recompute")
+                cfg.use_recompute = True
+                cfg.recompute_granularity = "core_attn"
+            else:
+                raise
     # meter timed K-step dispatches; rescale to per-step
     res["step_time_s"] /= K
     log(f"compile+warmup+{iters}x{K}-step dispatches took "
@@ -119,12 +135,16 @@ def main():
     mfu = res.get("mfu")
     if mfu:
         out = {
-            "metric": "llama_941m_1chip_train_mfu",
+            "metric": "llama_7b_shape_e2e_train_mfu",
             "value": round(mfu * 100, 2),
             "unit": "%MFU",
             "vs_baseline": round(mfu / 0.45, 3),
             "tokens_per_sec_per_chip": round(res["tokens_per_sec_per_chip"]),
             "device": dev.device_kind,
+            # config actually measured (differs from headline after an
+            # OOM fallback — comparable only same-config)
+            "seq": seq,
+            "remat": bool(cfg.use_recompute),
         }
     else:  # unknown peak (CPU smoke) — report throughput
         out = {

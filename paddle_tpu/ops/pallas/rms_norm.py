@@ -139,9 +139,12 @@ def rms_norm(x, weight, epsilon=1e-6, block_rows=None):
     for s in lead:
         rows *= s
     if block_rows is None:
-        # the bwd kernel holds ~4 (block, N) f32 tiles in VMEM (~16MB);
-        # shrink the row block as the feature dim grows
-        budget = 4 * 1024 * 1024 // max(n, 1) // 4  # rows for one 4MB tile
+        # the bwd kernel's scoped-VMEM demand (double-buffered bf16
+        # in/out tiles + f32 compute temporaries) scales ~linearly with
+        # block*N and measures ~11MB at 256x2048 on v5e (22MB at
+        # 256x4096 = compile OOM against the 16MB limit); cap the
+        # product at the known-safe 256x2048
+        budget = (256 * 2048) // max(n, 1)
         block_rows = max(8, min(DEFAULT_BLOCK_ROWS, _round_up(budget, 8) or 8))
     # pad rows to a full block multiple so no partial/garbage block ever
     # feeds the dw accumulation (padded rows are zeros → zero dy → no-op)
