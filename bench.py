@@ -117,6 +117,9 @@ def main():
         except Exception as e:  # OOM → shorter sequence, then remat
             if "RESOURCE_EXHAUSTED" not in str(e):
                 raise
+            # the failed attempt's params/master/moments (~10GB) must be
+            # freed BEFORE the retry builds its own, or the retry OOMs too
+            model = step = ids_stacked = meter = None
             if seq > 2048:
                 log(f"OOM at seq={seq}; halving ({e.__class__.__name__})")
                 seq //= 2

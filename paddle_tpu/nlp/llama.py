@@ -144,7 +144,8 @@ class LlamaAttention(Layer):
             self.v_proj = Linear(config.hidden_size, hk * d, bias_attr=False)
             self.o_proj = Linear(h * d, config.hidden_size, bias_attr=False)
 
-    def forward(self, hidden, position_offset=0, cache=None):
+    def forward(self, hidden, position_offset=0, cache=None,
+                cu_seqlens=None, position_ids=None):
         b, s, _ = hidden.shape
         q = self.q_proj(hidden).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
@@ -154,10 +155,34 @@ class LlamaAttention(Layer):
             s, self.head_dim, base=self.config.rope_theta,
             position_offset=position_offset,
         )
-        q = apply(lambda t: apply_rotary_emb(t, cos, sin), q, op_name="rope_q")
-        k = apply(lambda t: apply_rotary_emb(t, cos, sin), k, op_name="rope_k")
+        if position_ids is not None:
+            # packed-varlen training: rotary positions restart at every
+            # segment boundary (position_ids precomputed from cu_seqlens)
+            q = apply(lambda t, pid: apply_rotary_emb(
+                t, cos, sin, position_ids=pid), q, position_ids,
+                op_name="rope_q")
+            k = apply(lambda t, pid: apply_rotary_emb(
+                t, cos, sin, position_ids=pid), k, position_ids,
+                op_name="rope_k")
+        else:
+            q = apply(lambda t: apply_rotary_emb(t, cos, sin), q,
+                      op_name="rope_q")
+            k = apply(lambda t: apply_rotary_emb(t, cos, sin), k,
+                      op_name="rope_k")
 
-        if cache is not None:
+        if cu_seqlens is not None:
+            # packed ragged sequences, (B=1, T) layout: the Pallas varlen
+            # kernel skips dead cross-segment tiles AND their KV DMA
+            # (ops/pallas/varlen_flash_attention.py)
+            t = b * s
+            out, _ = F.flash_attn_unpadded(
+                q.reshape([t, self.num_heads, self.head_dim]),
+                k.reshape([t, self.num_kv_heads, self.head_dim]),
+                v.reshape([t, self.num_kv_heads, self.head_dim]),
+                cu_seqlens, cu_seqlens, s, s,
+                scale=1.0 / math.sqrt(self.head_dim), causal=True)
+            out = out.reshape([b, s, self.num_heads, self.head_dim])
+        elif cache is not None:
             # incremental decode: cache is (k_cache, v_cache) Tensors laid
             # out (B, S_max, HK, D) with valid length = position_offset + s
             k, v, cache = self._update_cache(k, v, cache, position_offset)
@@ -177,9 +202,11 @@ class LlamaAttention(Layer):
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.o_proj(out), cache
 
-    def forward_no_cache(self, hidden, position_offset=0):
+    def forward_no_cache(self, hidden, position_offset=0,
+                         cu_seqlens=None, position_ids=None):
         """Single-output variant for the remat wrapper (core_attn)."""
-        out, _ = self.forward(hidden, position_offset, None)
+        out, _ = self.forward(hidden, position_offset, None,
+                              cu_seqlens, position_ids)
         return out
 
     def _update_cache(self, k, v, cache, position_offset):
@@ -269,7 +296,8 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, hidden, position_offset=0, cache=None):
+    def forward(self, hidden, position_offset=0, cache=None,
+                cu_seqlens=None, position_ids=None):
         residual = hidden
         # PaddleNLP-parity granularities: full_attn/core_attn remat only
         # the attention sublayer (its softmax/score intermediates), which
@@ -286,20 +314,37 @@ class LlamaDecoderLayer(Layer):
             attn_out = recompute(
                 self.self_attn.forward_no_cache,
                 self.input_layernorm(hidden), position_offset,
+                cu_seqlens, position_ids,
             )
         else:
             attn_out, cache = self.self_attn(
-                self.input_layernorm(hidden), position_offset, cache)
+                self.input_layernorm(hidden), position_offset, cache,
+                cu_seqlens, position_ids)
         hidden = residual + attn_out
         hidden = _mark_hidden(hidden, self.config)
         hidden = hidden + self.mlp(self.post_attention_layernorm(hidden))
         hidden = _mark_hidden(hidden, self.config)
         return hidden, cache
 
-    def forward_no_cache(self, hidden, position_offset=0):
+    def forward_no_cache(self, hidden, position_offset=0,
+                         cu_seqlens=None, position_ids=None):
         """Single-output variant for the recompute (remat) wrapper."""
-        out, _ = self.forward(hidden, position_offset, None)
+        out, _ = self.forward(hidden, position_offset, None,
+                              cu_seqlens, position_ids)
         return out
+
+
+def packed_position_ids(cu_seqlens, total_tokens):
+    """Per-token rotary positions for a packed (1, T) batch: positions
+    restart at every ``cu_seqlens`` boundary. Returns a (1, T) Tensor."""
+    import jax.numpy as jnp
+
+    def fn(cu):
+        t = jnp.arange(total_tokens, dtype=jnp.int32)
+        seg = jnp.searchsorted(cu, t, side="right") - 1
+        return (t - cu[seg])[None, :]
+
+    return apply(fn, ensure_tensor(cu_seqlens), op_name="packed_position_ids")
 
 
 class LlamaModel(Layer):
@@ -322,9 +367,19 @@ class LlamaModel(Layer):
             self.layers.append(layer)
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, position_offset=0, caches=None):
+    def forward(self, input_ids, position_offset=0, caches=None,
+                cu_seqlens=None):
         hidden = self.embed_tokens(input_ids)
         hidden = _mark_hidden(hidden, self.config)
+        position_ids = None
+        if cu_seqlens is not None:
+            if caches is not None:
+                raise ValueError(
+                    "packed cu_seqlens training and KV caches are "
+                    "mutually exclusive (serving uses the paged path)")
+            cu_seqlens = ensure_tensor(cu_seqlens)
+            position_ids = packed_position_ids(
+                cu_seqlens, int(input_ids.shape[0]) * int(input_ids.shape[1]))
         new_caches = [] if caches is not None else None
         gran = self.config.recompute_granularity
         if self.config.use_recompute and gran not in (
@@ -348,9 +403,10 @@ class LlamaModel(Layer):
                 from ..distributed.fleet.utils.recompute import recompute
 
                 hidden = recompute(layer.forward_no_cache, hidden,
-                                   position_offset)
+                                   position_offset, cu_seqlens, position_ids)
             else:
-                hidden, cache_i = layer(hidden, position_offset, cache_i)
+                hidden, cache_i = layer(hidden, position_offset, cache_i,
+                                        cu_seqlens, position_ids)
             if new_caches is not None:
                 new_caches.append(cache_i)
         return self.norm(hidden), new_caches
@@ -373,8 +429,10 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids, position_offset=0, caches=None):
-        hidden, new_caches = self.llama(input_ids, position_offset, caches)
+    def forward(self, input_ids, position_offset=0, caches=None,
+                cu_seqlens=None):
+        hidden, new_caches = self.llama(input_ids, position_offset, caches,
+                                        cu_seqlens)
         logits = self.lm_head(hidden)
         if caches is not None:
             return logits, new_caches
@@ -401,10 +459,36 @@ class LlamaPretrainingCriterion(Layer):
     def __init__(self, config: LlamaConfig = None):
         super().__init__()
 
-    def forward(self, logits, labels):
+    def forward(self, logits, labels, cu_seqlens=None):
         shifted = logits[:, :-1, :]
         targets = labels[:, 1:]
-        return F.cross_entropy(
+        if cu_seqlens is None:
+            return F.cross_entropy(
+                shifted.reshape([-1, shifted.shape[-1]]),
+                targets.reshape([-1]),
+            )
+        # packed batch: a segment's last token must not predict the next
+        # segment's first token — mask the cross-boundary positions.
+        # Packed layout is (1, T): with B>1 the per-row shift would break
+        # the flat position <-> cu_seqlens correspondence below.
+        if int(logits.shape[0]) != 1:
+            raise ValueError(
+                f"packed cu_seqlens criterion expects batch 1 (packed "
+                f"(1, T) layout), got batch {logits.shape[0]}")
+        import jax.numpy as jnp
+
+        per_tok = F.cross_entropy(
             shifted.reshape([-1, shifted.shape[-1]]),
-            targets.reshape([-1]),
+            targets.reshape([-1]), reduction="none",
         )
+
+        def masked_mean(losses, cu):
+            t = losses.shape[0]  # = T - 1
+            pos = jnp.arange(t, dtype=jnp.int32)
+            seg_here = jnp.searchsorted(cu, pos, side="right")
+            seg_next = jnp.searchsorted(cu, pos + 1, side="right")
+            mask = (seg_here == seg_next).astype(losses.dtype)
+            return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        return apply(masked_mean, per_tok, ensure_tensor(cu_seqlens),
+                     op_name="packed_criterion")

@@ -191,3 +191,52 @@ def test_core_attn_remat_eager_grads_flow():
     q = m.llama.layers[0].self_attn.q_proj.weight
     assert q.grad is not None
     assert float(np.abs(np.asarray(q.grad._value)).sum()) > 0
+
+
+def test_llama_packed_varlen_matches_per_sequence():
+    """Packed cu_seqlens training path (round-4): logits of each packed
+    segment must equal a separate forward of that segment alone (same
+    rope restart, no cross-segment attention), and the packed criterion
+    must equal the mean of per-segment shifted CE."""
+    mesh_state.set_mesh(None)
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.RandomState(3)
+    lens = [5, 9, 2]
+    T = sum(lens)
+    ids_np = rng.randint(1, cfg.vocab_size, (1, T)).astype(np.int64)
+    cu = np.cumsum([0] + lens).astype(np.int32)
+
+    packed = model(paddle.to_tensor(ids_np),
+                   cu_seqlens=paddle.to_tensor(cu))
+    packed_np = np.asarray(packed._value)
+
+    for i in range(len(lens)):
+        seg = ids_np[:, cu[i]:cu[i + 1]]
+        alone = np.asarray(model(paddle.to_tensor(seg))._value)
+        np.testing.assert_allclose(
+            packed_np[:, cu[i]:cu[i + 1]], alone, rtol=2e-4, atol=2e-4)
+
+    # criterion: boundary positions masked out
+    crit = LlamaPretrainingCriterion()
+    labels = paddle.to_tensor(ids_np)
+    packed_loss = float(crit(packed, labels,
+                             cu_seqlens=paddle.to_tensor(cu)))
+    tok_losses = []
+    for i in range(len(lens)):
+        seg = ids_np[:, cu[i]:cu[i + 1]]
+        if seg.shape[1] < 2:
+            continue
+        out = model(paddle.to_tensor(seg))
+        import paddle_tpu.nn.functional as F
+
+        per = F.cross_entropy(
+            out[:, :-1, :].reshape([-1, cfg.vocab_size]),
+            paddle.to_tensor(seg[:, 1:]).reshape([-1]),
+            reduction="none")
+        tok_losses.extend(np.asarray(per._value).tolist())
+    np.testing.assert_allclose(
+        packed_loss, float(np.mean(tok_losses)), rtol=2e-4, atol=2e-4)
