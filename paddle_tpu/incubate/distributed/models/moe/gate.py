@@ -72,6 +72,35 @@ class TopKGate:
         self.l_aux = aux
         return dispatch, combine, cap
 
+    def topk_assignments(self, logits):
+        """Sparse form of the SAME routing decision (grouped-matmul
+        dispatch tier): logits (T, E) → (expert_ids (T, k), gate_vals
+        (T, k) with capacity-dropped slots zeroed, aux). Capacity
+        semantics match __call__: round-major queueing — every token's
+        r-th choice is queued before any token's (r+1)-th choice."""
+        t, e = logits.shape
+        cap = _capacity(t, e, self.capacity_factor, self.top_k)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        topv, topi = jax.lax.top_k(gates, self.top_k)   # (T, k) desc
+        ce_counts = jnp.zeros((e,), jnp.float32)
+        pos_base = jnp.zeros((e,), jnp.int32)
+        kept = []
+        for r in range(self.top_k):
+            sel = jax.nn.one_hot(topi[:, r], e, dtype=jnp.float32)
+            ce_counts = ce_counts + jnp.sum(sel, axis=0)
+            pos_in = jnp.cumsum(sel, axis=0) - sel
+            pos = (pos_in + pos_base[None, :]).astype(jnp.int32)
+            keep = (sel > 0) & (pos < cap)              # (T, E)
+            pos_base = pos_base + jnp.sum(keep, axis=0).astype(jnp.int32)
+            kept.append(jnp.any(keep, axis=1))
+        keep_mask = jnp.stack(kept, axis=1)             # (T, k)
+        gate_vals = topv * keep_mask.astype(topv.dtype)
+        me = jnp.mean(gates, axis=0)
+        fraction = ce_counts / jnp.maximum(jnp.sum(ce_counts), 1.0)
+        aux = jnp.sum(fraction * me) * e
+        self.l_aux = aux
+        return topi, gate_vals, aux
+
 
 class GShardGate(TopKGate):
     def __init__(self, capacity_factor=2.0):

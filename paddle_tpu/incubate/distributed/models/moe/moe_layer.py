@@ -36,7 +36,7 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
                  activation="gelu", capacity_factor=2.0, expert_axis=None,
-                 name=None):
+                 dispatch_mode="auto", name=None):
         super().__init__()
         self.d_model = d_model
         self.d_hidden = d_hidden
@@ -74,12 +74,85 @@ class MoELayer(Layer):
                 p.is_distributed = True
                 spec = [axis] + [None] * (p._value.ndim - 1)
                 p._value = mesh_state.shard_value(p._value, *spec)
+        if dispatch_mode not in ("auto", "einsum", "grouped"):
+            raise ValueError(
+                f"dispatch_mode must be auto|einsum|grouped, got "
+                f"{dispatch_mode!r}")
+        # grouped (sort + lax.ragged_dot) is the perf tier: O(T*k) rows
+        # of matmul instead of the dense (T, E, C) einsums. The einsum
+        # tier remains the EP-sharded path — GSPMD turns its expert-dim
+        # constraints into the all-to-all; the sorted ragged layout has
+        # no static per-device partition for the partitioner to use.
+        if dispatch_mode == "auto":
+            # custom gate objects only promise the __call__ → (dispatch,
+            # combine, cap) contract; grouped needs the sparse
+            # topk_assignments form
+            dispatch_mode = (
+                "grouped" if axis is None
+                and hasattr(self.gate, "topk_assignments") else "einsum")
+        if dispatch_mode == "grouped" and axis is not None:
+            raise ValueError(
+                "dispatch_mode='grouped' is the single-device/local tier;"
+                " EP-sharded experts use the einsum path (GSPMD"
+                " all-to-all)"
+            )
+        self.dispatch_mode = dispatch_mode
+
+    def _act(self, h):
+        if self.activation == "swiglu":
+            g_, u_ = jnp.split(h, 2, axis=-1)
+            return jax.nn.silu(g_.astype(jnp.float32)).astype(u_.dtype) * u_
+        return jax.nn.gelu(h.astype(jnp.float32)).astype(h.dtype)
+
+    def _grouped_fn(self, xv, gw, w1, b1, w2, b2):
+        """Sort/segment grouped-matmul dispatch (megablocks-style): the
+        T*k routed rows are sorted by expert and fed to
+        ``jax.lax.ragged_dot`` with per-expert group sizes — O(T*k)
+        matmul rows and O(T*k*M) memory, vs the dense einsum tier's
+        (T, E, C) dispatch tensor. Same gate, same capacity-drop
+        semantics (dropped rows keep their slot but combine with weight
+        zero), same aux loss."""
+        cfg = self
+        lead = xv.shape[:-1]
+        t = 1
+        for s in lead:
+            t *= s
+        k = cfg.gate.top_k
+        e = cfg.num_experts
+        xt = xv.reshape(t, cfg.d_model)
+        logits = xt.astype(jnp.float32) @ gw.astype(jnp.float32)
+        topi, gate_vals, aux = cfg.gate.topk_assignments(logits)
+
+        expert_flat = topi.reshape(-1)                    # (T*k,)
+        gv_flat = gate_vals.reshape(-1)
+        tok_flat = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
+        order = jnp.argsort(expert_flat)                  # stable
+        sorted_tok = tok_flat[order]
+        sorted_exp = expert_flat[order]
+        sorted_gv = gv_flat[order].astype(xv.dtype)
+        group_sizes = jnp.bincount(expert_flat, length=e).astype(jnp.int32)
+
+        xs = xt[sorted_tok]                               # (T*k, M)
+        h = jax.lax.ragged_dot(xs, w1.astype(xv.dtype), group_sizes)
+        h = h + b1[sorted_exp].astype(xv.dtype)
+        h = self._act(h)
+        out = jax.lax.ragged_dot(h, w2.astype(xv.dtype), group_sizes)
+        out = out + b2[sorted_exp].astype(xv.dtype)
+        y = jnp.zeros((t, cfg.d_model), xv.dtype).at[sorted_tok].add(
+            out * sorted_gv[:, None])
+        return y.reshape(*lead, cfg.d_model), aux
 
     def forward(self, x):
         """x: (..., d_model) → same shape; self.l_aux holds the aux loss."""
         x = ensure_tensor(x)
         gate = self.gate
         cfg = self
+
+        if self.dispatch_mode == "grouped":
+            out, self.l_aux = apply(
+                self._grouped_fn, x, self.gate_weight, self.w1, self.b1,
+                self.w2, self.b2, op_name="moe_layer_grouped")
+            return out
 
         def fn(xv, gw, w1, b1, w2, b2):
             lead = xv.shape[:-1]
@@ -97,11 +170,7 @@ class MoELayer(Layer):
                 disp = mesh_state.constraint(disp, cfg.expert_axis, None, None)
             h = jnp.einsum("ecm,emh->ech", disp, w1.astype(xv.dtype))
             h = h + b1[:, None, :].astype(xv.dtype)
-            if cfg.activation == "swiglu":
-                g_, u_ = jnp.split(h, 2, axis=-1)
-                h = jax.nn.silu(g_.astype(jnp.float32)).astype(u_.dtype) * u_
-            else:
-                h = jax.nn.gelu(h.astype(jnp.float32)).astype(h.dtype)
+            h = cfg._act(h)
             out = jnp.einsum("ech,ehm->ecm", h, w2.astype(xv.dtype))
             out = out + b2[:, None, :].astype(xv.dtype)
             if cfg.expert_axis is not None:

@@ -377,9 +377,13 @@ class LlamaModel(Layer):
                 raise ValueError(
                     "packed cu_seqlens training and KV caches are "
                     "mutually exclusive (serving uses the paged path)")
+            if int(input_ids.shape[0]) != 1:
+                raise ValueError(
+                    f"packed cu_seqlens training expects the (1, T) "
+                    f"packed layout, got batch {input_ids.shape[0]}")
             cu_seqlens = ensure_tensor(cu_seqlens)
             position_ids = packed_position_ids(
-                cu_seqlens, int(input_ids.shape[0]) * int(input_ids.shape[1]))
+                cu_seqlens, int(input_ids.shape[1]))
         new_caches = [] if caches is not None else None
         gran = self.config.recompute_granularity
         if self.config.use_recompute and gran not in (
