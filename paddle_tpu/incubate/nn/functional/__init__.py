@@ -226,17 +226,23 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     from ....ops.pallas.varlen_flash_attention import varlen_flash_attention
     from ....tensor._helpers import apply
 
-    if use_neox_rotary_style or any(
+    if any(
         kwargs.get(k) is not None
-        for k in ("rotary_embs", "qkv_bias", "qkv_out_scale",
-                  "cache_k_quant_scales", "cache_v_quant_scales",
-                  "out_shift", "out_smooth")
+        for k in ("qkv_out_scale", "cache_k_quant_scales",
+                  "cache_v_quant_scales", "out_shift", "out_smooth")
     ):
         # silently ignoring these would produce numerically wrong
         # attention (the reference applies them inside the op)
         raise NotImplementedError(
-            "block_multihead_attention: rotary/bias/quant fusion args are "
-            "not supported here — apply rope/bias before the call")
+            "block_multihead_attention: activation-quant fusion args are "
+            "not supported here — weight-only int8 serving quantizes the "
+            "projections (paddle.quantization), not this op's epilogue")
+    # rope/bias fusion (reference contract: applied INSIDE the op, to
+    # this call's new q/k tokens at their absolute cache positions):
+    #   rotary_embs: (2, max_seq_len, head_dim//2) — [0]=cos, [1]=sin
+    #   qkv_bias:    ((H + 2*HK) * D,)
+    rotary_embs = kwargs.get("rotary_embs")
+    qkv_bias = kwargs.get("qkv_bias")
     qkv = ensure_tensor(qkv)
     key_cache = ensure_tensor(key_cache)
     value_cache = ensure_tensor(value_cache)
@@ -307,8 +313,34 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     dec_positions = jnp.asarray(dec_lens[dec_rows], jnp.int32)
     dec_tbl = jnp.asarray(tbl_np[dec_rows]) if len(dec_rows) else None
 
-    def fn(qkv_v, kp, vp):
+    if rotary_embs is not None:
+        # JAX gathers CLAMP out-of-bounds indices — generation past the
+        # rope table would silently reuse the last angle forever
+        table_len = int(ensure_tensor(rotary_embs)._value.shape[1])
+        if total and int(abs_pos.max()) >= table_len:
+            raise ValueError(
+                f"block_multihead_attention: token position "
+                f"{int(abs_pos.max())} exceeds rotary_embs table length "
+                f"{table_len}")
+
+    abs_pos_j = jnp.asarray(abs_pos)
+
+    def fn(qkv_v, kp, vp, *fused):
+        fused = list(fused)
+        rot = fused.pop(0) if rotary_embs is not None else None
+        bias = fused.pop(0) if qkv_bias is not None else None
+        if bias is not None:
+            qkv_v = qkv_v + bias.astype(qkv_v.dtype)[None, :]
         q, k_new, v_new = split_qkv(qkv_v)
+        if rot is not None:
+            from ....nn.functional.rope import apply_rotary_emb
+
+            cos, sin = rot[0], rot[1]  # (max_seq, D/2)
+            neox = bool(use_neox_rotary_style)
+            q = apply_rotary_emb(q[None], cos, sin, neox=neox,
+                                 position_ids=abs_pos_j[None])[0]
+            k_new = apply_rotary_emb(k_new[None], cos, sin, neox=neox,
+                                     position_ids=abs_pos_j[None])[0]
         kp2 = kp.at[blk_ids, offs].set(k_new.astype(kp.dtype))
         vp2 = vp.at[blk_ids, offs].set(v_new.astype(vp.dtype))
         out = jnp.zeros((total, h, d), q.dtype)
@@ -329,8 +361,13 @@ def block_multihead_attention(qkv, key_cache, value_cache,
             out = out.at[jnp.asarray(dec_tok)].set(o_dec)
         return out.reshape(total, h * d), kp2, vp2
 
+    fused_args = []
+    if rotary_embs is not None:
+        fused_args.append(ensure_tensor(rotary_embs))
+    if qkv_bias is not None:
+        fused_args.append(ensure_tensor(qkv_bias))
     out, new_k, new_v = apply(
-        fn, qkv, key_cache, value_cache,
+        fn, qkv, key_cache, value_cache, *fused_args,
         op_name="block_multihead_attention",
     )
     key_cache._value = new_k._value

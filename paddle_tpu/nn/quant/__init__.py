@@ -98,6 +98,17 @@ def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
     return dequantize_linear(x, scale, axis=1)
 
 
+def weight_quantize_stacked(w, axis=1):
+    """weight_quantize for a STACKED (L, in, out) weight: per-layer,
+    per-out-channel int8 + (L, out) scales. Same algorithm as
+    weight_quantize, kept beside it so the quant math lives once."""
+    import jax.numpy as _jnp
+
+    scale = _jnp.maximum(_jnp.max(_jnp.abs(w), axis=axis), 1e-8) / 127.0
+    q = _jnp.clip(_jnp.round(w / _jnp.expand_dims(scale, axis)), -128, 127)
+    return q.astype(_jnp.int8), scale.astype(_jnp.float32)
+
+
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", name=None):
     """y = x @ dequant(weight) + bias — weight stays int8 in HBM; the
@@ -112,8 +123,11 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         args.append(ensure_tensor(bias))
 
     def fn(xv, wq, ws, *maybe_b):
-        w = wq.astype(xv.dtype) * ws.astype(xv.dtype)[None, :]
-        y = xv @ w
+        # per-out-channel scale commutes with the contraction: scale the
+        # OUTPUT, so the weight feeds the matmul straight from its int8
+        # HBM residency (no dequantized bf16 weight copy); plain
+        # broadcast keeps 1-D inputs returning 1-D outputs
+        y = (xv @ wq.astype(xv.dtype)) * ws.astype(xv.dtype)
         if maybe_b:
             y = y + maybe_b[0]
         return y

@@ -335,3 +335,53 @@ def test_moe_grouped_rejects_expert_axis():
         MoELayer(16, 32, num_experts=4, expert_axis="dp",
                  dispatch_mode="grouped")
     mesh_state.set_mesh(None)
+
+
+def test_fused_multi_transformer_weight_only_int8_parity():
+    """Round-4 verdict #5: the int8 fused_multi_transformer variant.
+    quantize_weight_only() output must EXACTLY match a float FMT whose
+    weights are the dequantized (int8 * scale) values — proving the
+    serving stack consumes the artifact with no wiring error. Prefill
+    AND decode; int8 weights must actually live in HBM as int8."""
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    def build():
+        paddle.seed(4)
+        return FusedMultiTransformer(
+            64, 4, 128, num_layers=3, norm_type="rmsnorm",
+            activation="swiglu", num_key_value_heads=2).eval()
+
+    fmt_q = build().quantize_weight_only()
+    assert fmt_q.qkv_weight._value.dtype == jnp.int8
+    fmt_ref = build()
+    # install the dequantized weights into the float reference
+    for name in ("qkv_weight", "linear_weight", "ffn1_weight",
+                 "ffn2_weight"):
+        q = getattr(fmt_q, name)._value.astype(jnp.float32)
+        s = getattr(fmt_q, name + "_scale")._value
+        getattr(fmt_ref, name).set_value(paddle.Tensor(q * s[:, None, :]))
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8, 64).astype("f4"))
+    cq = fmt_q.gen_cache(2, 32)
+    cr = fmt_ref.gen_cache(2, 32)
+    out_q, cq = fmt_q(x, caches=cq, time_step=0)
+    out_r, cr = fmt_ref(x, caches=cr, time_step=0)
+    np.testing.assert_allclose(
+        np.asarray(out_q._value), np.asarray(out_r._value),
+        rtol=1e-5, atol=1e-5)
+    nxt = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 1, 64).astype("f4"))
+    dq, _ = fmt_q(nxt, caches=cq, time_step=8)
+    dr, _ = fmt_ref(nxt, caches=cr, time_step=8)
+    np.testing.assert_allclose(
+        np.asarray(dq._value), np.asarray(dr._value),
+        rtol=1e-5, atol=1e-5)
+    # and the quant error vs the ORIGINAL float weights is small but
+    # nonzero (guards against accidentally storing float weights)
+    fmt_f = build()
+    cf = fmt_f.gen_cache(2, 32)
+    out_f, _ = fmt_f(x, caches=cf, time_step=0)
+    diff = np.abs(np.asarray(out_q._value) - np.asarray(out_f._value))
+    assert 0 < diff.max() < 0.1

@@ -318,7 +318,9 @@ def test_block_mha_inactive_rows_skipped():
 def test_block_mha_rejects_unsupported_fusions():
     from paddle_tpu.incubate.nn.functional import block_multihead_attention
 
-    with pytest.raises(NotImplementedError, match="rotary"):
+    # rope/bias are fused since round 4; ACTIVATION-quant epilogue args
+    # must still refuse loudly (silent ignore = wrong numerics)
+    with pytest.raises(NotImplementedError, match="quant"):
         block_multihead_attention(
             paddle.to_tensor(np.zeros((1, 8 * 64), "f4")),
             paddle.to_tensor(np.zeros((2, 32, 2, 64), "f4")),
@@ -328,5 +330,126 @@ def test_block_mha_rejects_unsupported_fusions():
             seq_lens_this_time=paddle.to_tensor(np.ones(1, "i4")),
             block_tables=paddle.to_tensor(np.zeros((1, 1), "i4")),
             num_heads=4, kv_num_heads=2,
-            rotary_embs=paddle.to_tensor(np.zeros(4, "f4")),
+            qkv_out_scale=paddle.to_tensor(np.ones(4, "f4")),
         )
+
+
+def test_block_multihead_attention_fused_rope_bias_parity():
+    """Round-4 verdict #6: rotary_embs + qkv_bias accepted INSIDE the op
+    (reference contract) — parity vs apply-bias-then-rope-then-attend.
+    Covers prefill (fresh cache) and a decode step whose rope positions
+    must be the ABSOLUTE cache positions, both rope styles."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+    from paddle_tpu.nn.functional.rope import apply_rotary_emb
+
+    rng = np.random.RandomState(5)
+    h, hk, d, bs = 4, 2, 64, 32
+    lens = [7, 13]
+    b, total = len(lens), sum(lens)
+    max_seq = 64
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    ang = np.outer(np.arange(max_seq), inv)
+    rot_np = np.stack([np.cos(ang), np.sin(ang)]).astype("f4")  # (2,S,D/2)
+    bias_np = rng.randn((h + 2 * hk) * d).astype("f4") * 0.1
+
+    for neox in (True, False):
+        qkv_np = rng.randn(total, (h + 2 * hk) * d).astype("f4")
+        cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+
+        def pools():
+            pool = PagedKVCachePool(num_blocks=16, block_size=bs,
+                                    num_kv_heads=hk, head_dim=d,
+                                    dtype=jnp.float32)
+            for i, ln in enumerate(lens):
+                pool.ensure(i, ln)
+            kc = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+            vc = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+            return pool, kc, vc
+
+        common = dict(
+            seq_lens_encoder=paddle.to_tensor(np.asarray(lens, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.zeros(b, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.asarray(lens, "i4")),
+            num_heads=h, kv_num_heads=hk,
+        )
+        # fused path
+        pool, kc_f, vc_f = pools()
+        out_f = block_multihead_attention(
+            paddle.to_tensor(qkv_np), kc_f, vc_f,
+            block_tables=paddle.to_tensor(
+                np.asarray(pool.block_table_array(range(b)))),
+            rotary_embs=paddle.to_tensor(rot_np),
+            qkv_bias=paddle.to_tensor(bias_np),
+            use_neox_rotary_style=neox, **common)
+
+        # reference: bias + per-token rope applied BEFORE the plain op
+        biased = qkv_np + bias_np[None, :]
+        q = biased[:, : h * d].reshape(total, h, d)
+        k = biased[:, h * d: (h + hk) * d].reshape(total, hk, d)
+        pos = np.concatenate([np.arange(ln) for ln in lens]).astype("i4")
+        q_r = np.asarray(apply_rotary_emb(
+            jnp.asarray(q)[None], jnp.asarray(rot_np[0]),
+            jnp.asarray(rot_np[1]), neox=neox,
+            position_ids=jnp.asarray(pos)[None])[0])
+        k_r = np.asarray(apply_rotary_emb(
+            jnp.asarray(k)[None], jnp.asarray(rot_np[0]),
+            jnp.asarray(rot_np[1]), neox=neox,
+            position_ids=jnp.asarray(pos)[None])[0])
+        ref_qkv = np.concatenate(
+            [q_r.reshape(total, -1), k_r.reshape(total, -1),
+             biased[:, (h + hk) * d:]], axis=1).astype("f4")
+        pool2, kc_r, vc_r = pools()
+        out_r = block_multihead_attention(
+            paddle.to_tensor(ref_qkv), kc_r, vc_r,
+            block_tables=paddle.to_tensor(
+                np.asarray(pool2.block_table_array(range(b)))),
+            **common)
+        np.testing.assert_allclose(
+            np.asarray(out_f._value), np.asarray(out_r._value),
+            rtol=2e-5, atol=2e-5)
+        # caches must hold the ROTATED keys
+        np.testing.assert_allclose(
+            np.asarray(kc_f._value), np.asarray(kc_r._value),
+            rtol=2e-5, atol=2e-5)
+
+        # one decode step: fused rope must use ABSOLUTE position len_i
+        for i in range(b):
+            pool.ensure(i, lens[i] + 1)
+            pool2.ensure(i, lens[i] + 1)
+        qkv_dec = rng.randn(b, (h + 2 * hk) * d).astype("f4")
+        dec_common = dict(
+            seq_lens_encoder=paddle.to_tensor(np.zeros(b, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.asarray(lens, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.ones(b, "i4")),
+            num_heads=h, kv_num_heads=hk,
+        )
+        out_fd = block_multihead_attention(
+            paddle.to_tensor(qkv_dec), kc_f, vc_f,
+            block_tables=paddle.to_tensor(
+                np.asarray(pool.block_table_array(range(b)))),
+            rotary_embs=paddle.to_tensor(rot_np),
+            qkv_bias=paddle.to_tensor(bias_np),
+            use_neox_rotary_style=neox, **dec_common)
+        biased_d = qkv_dec + bias_np[None, :]
+        qd = biased_d[:, : h * d].reshape(b, h, d)
+        kd = biased_d[:, h * d: (h + hk) * d].reshape(b, hk, d)
+        pos_d = np.asarray(lens, "i4")
+        qd_r = np.asarray(apply_rotary_emb(
+            jnp.asarray(qd)[None], jnp.asarray(rot_np[0]),
+            jnp.asarray(rot_np[1]), neox=neox,
+            position_ids=jnp.asarray(pos_d)[None])[0])
+        kd_r = np.asarray(apply_rotary_emb(
+            jnp.asarray(kd)[None], jnp.asarray(rot_np[0]),
+            jnp.asarray(rot_np[1]), neox=neox,
+            position_ids=jnp.asarray(pos_d)[None])[0])
+        ref_qkv_d = np.concatenate(
+            [qd_r.reshape(b, -1), kd_r.reshape(b, -1),
+             biased_d[:, (h + hk) * d:]], axis=1).astype("f4")
+        out_rd = block_multihead_attention(
+            paddle.to_tensor(ref_qkv_d), kc_r, vc_r,
+            block_tables=paddle.to_tensor(
+                np.asarray(pool2.block_table_array(range(b)))),
+            **dec_common)
+        np.testing.assert_allclose(
+            np.asarray(out_fd._value), np.asarray(out_rd._value),
+            rtol=2e-5, atol=2e-5)

@@ -132,3 +132,57 @@ def test_ptq_act_scale_feeds_a8w8():
     ref = np.asarray(model(x)._value) if False else None
     out = infer(x)
     assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_int8_llama_decode_parity_and_predictor(tmp_path):
+    """Round-4 verdict #5: the serving stack consumes weight-only-int8
+    artifacts end-to-end. (a) PTQ-converted Llama decodes with EXACT
+    parity vs a float model holding the dequantized weights (wiring,
+    not quant error); (b) the converted model survives jit.save →
+    inference.Config → Predictor with the same outputs."""
+    import os
+    import jax.numpy as jnp
+    import paddle_tpu.inference as infer
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import generate_on_device
+
+    def build():
+        paddle.seed(9)
+        m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        m.eval()
+        return m
+
+    q_model = PTQ(QuantConfig()).convert(PTQ(QuantConfig()).quantize(build()))
+    # every Linear became weight-only int8
+    qlinears = [l for _, l in q_model.named_sublayers()
+                if isinstance(l, QuantizedLinear)]
+    assert qlinears and all(
+        l.quant_weight._value.dtype == jnp.int8 for l in qlinears)
+
+    # float reference with the DEQUANTIZED weights installed
+    ref = build()
+    ref_linears = {n: l for n, l in ref.named_sublayers()
+                   if isinstance(l, nn.Linear)}
+    for name, ql in q_model.named_sublayers():
+        if isinstance(ql, QuantizedLinear):
+            w = (ql.quant_weight._value.astype(jnp.float32)
+                 * ql.weight_scale._value[None, :])
+            ref_linears[name].weight.set_value(paddle.Tensor(w))
+
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(1, 128, (2, 8)))
+    out_q = generate_on_device(q_model, ids, max_new_tokens=6)
+    out_r = generate_on_device(ref, ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out_q.numpy(), out_r.numpy())
+
+    # (b) Predictor path on the int8 artifact
+    path = os.path.join(str(tmp_path), "llama_int8")
+    paddle.jit.save(q_model, path, input_spec=[InputSpec([2, 8], "int64")])
+    pred = infer.create_predictor(infer.Config(path))
+    names = pred.get_input_names()
+    pred.get_input_handle(names[0]).copy_from_cpu(ids.numpy())
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    want = q_model(ids).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
