@@ -137,3 +137,24 @@ from .core.dispatch import (  # noqa: E402
 )
 
 _pop_reg()
+
+
+def __getattr__(name):
+    # lazy: paddle.distributed / paddle.DataParallel must not import the
+    # distributed stack (and touch the backend bootstrap) at package
+    # import time
+    if name == "distributed":
+        from . import distributed
+
+        return distributed
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+
+        return DataParallel
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    # lazy __getattr__ names must be discoverable (dir() feeds the API
+    # manifest generator and user introspection)
+    return sorted(set(globals()) | {"distributed", "DataParallel"})

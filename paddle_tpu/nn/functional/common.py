@@ -290,7 +290,42 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: PS-era API; not in round 1")
+    """paddle.nn.functional.class_center_sample (PLSC margin-softmax
+    helper): keep every positive class, top up with uniformly sampled
+    negatives to ``num_samples``, and remap labels into the sampled
+    index space. Returns (remapped_label, sampled_class_center).
+
+    Output size is data-dependent (|positives| may exceed num_samples),
+    so this is an EAGER op — the margin-softmax training loop calls it
+    on host-side label batches, like the reference's GPU op driven from
+    the python layer."""
+    import numpy as np
+
+    label = ensure_tensor(label)
+    if isinstance(label._value, jax.core.Tracer):
+        raise ValueError(
+            "class_center_sample has data-dependent output shapes and "
+            "cannot run under jit tracing; call it eagerly on the label "
+            "batch")
+    lab = np.asarray(label._value).reshape(-1)
+    pos = np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        from ...core.random import next_key
+
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos,
+                                assume_unique=True)
+        k = int(jax.random.key_data(next_key())[-1])
+        perm = np.random.RandomState(k % (2 ** 31)).permutation(neg_pool)
+        sampled = np.concatenate(
+            [pos, perm[: num_samples - pos.size]])
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    from ...core.tensor import Tensor
+
+    return (Tensor(jnp.asarray(remap[lab].reshape(label.shape))),
+            Tensor(jnp.asarray(sampled)))
 
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):

@@ -87,9 +87,7 @@ def weight_quantize(x, algo="weight_only_int8", name=None):
     x = ensure_tensor(x)
 
     def fn(w):
-        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8) / 127.0
-        q = jnp.clip(jnp.round(w / scale[None, :]), -128, 127)
-        return q.astype(jnp.int8), scale.astype(jnp.float32)
+        return weight_quantize_stacked(w, axis=0)
 
     return apply(fn, x, op_name="weight_quantize")
 

@@ -170,6 +170,7 @@ _INPLACE = {
     "round_": math.round, "reciprocal_": math.reciprocal, "neg_": math.neg,
     "tanh_": math.tanh, "sigmoid_": math.sigmoid, "pow_": math.pow,
     "remainder_": math.remainder, "mod_": math.mod,
+    "hypot_": math.hypot,
 }
 for _name, _fn in _INPLACE.items():
     def _make(_fn):

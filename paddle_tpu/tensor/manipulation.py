@@ -632,5 +632,117 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
     return apply(fn, ensure_tensor(input), op_name="shard_index")
 
 
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    """paddle.slice_scatter: write ``value`` into the slice of ``x``
+    selected by (axes, starts, ends, strides)."""
+    import builtins
+
+    x, value = ensure_tensor(x), ensure_tensor(value)
+    strides = strides or [1] * len(axes)
+    if not (len(axes) == len(starts) == len(ends) == len(strides)):
+        raise ValueError(
+            f"slice_scatter: axes/starts/ends/strides lengths differ: "
+            f"{len(axes)}/{len(starts)}/{len(ends)}/{len(strides)}")
+
+    def fn(v, val):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+
+    return apply(fn, x, value, op_name="slice_scatter")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """paddle.as_strided: element-stride view over the flattened buffer
+    (materialized as a gather — functional arrays have no aliasing)."""
+    x = ensure_tensor(x)
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = jnp.full(shape, int(offset), jnp.int32)
+        for d, (sz, sd) in enumerate(zip(shape, stride)):
+            br = [1] * len(shape)
+            br[d] = sz
+            idx = idx + (jnp.arange(sz, dtype=jnp.int32) * sd).reshape(br)
+        return flat[idx]
+
+    return apply(fn, x, op_name="as_strided")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """paddle.diagonal_scatter: write ``y`` onto the selected diagonal."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(v, val):
+        a1 = axis1 % v.ndim
+        a2 = axis2 % v.ndim
+        m = jnp.moveaxis(v, (a1, a2), (-2, -1))
+        rows, cols = m.shape[-2], m.shape[-1]
+        if offset >= 0:
+            n = min(rows, cols - offset)
+            ri, ci = jnp.arange(n), jnp.arange(n) + offset
+        else:
+            n = min(rows + offset, cols)
+            ri, ci = jnp.arange(n) - offset, jnp.arange(n)
+        m = m.at[..., ri, ci].set(val.astype(v.dtype))
+        return jnp.moveaxis(m, (-2, -1), (a1, a2))
+
+    return apply(fn, x, y, op_name="diagonal_scatter")
+
+
+def column_stack(x, name=None):
+    """paddle.column_stack: 1-D tensors become columns; others concat on
+    axis 1."""
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.column_stack(vs), *ts,
+                 op_name="column_stack")
+
+
+def row_stack(x, name=None):
+    """paddle.row_stack (alias of vstack)."""
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.vstack(vs), *ts, op_name="row_stack")
+
+
+def cartesian_prod(x, name=None):
+    """paddle.cartesian_prod: cartesian product of 1-D tensors → (N, k)
+    (one column per input; a single input returns 1-D, torch/paddle
+    semantics)."""
+    ts = [ensure_tensor(t) for t in x]
+
+    def fn(*vs):
+        if len(vs) == 1:
+            return vs[0]
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=1)
+
+    return apply(fn, *ts, op_name="cartesian_prod")
+
+
+def block_diag(inputs, name=None):
+    """paddle.block_diag: assemble 2-D blocks on the diagonal."""
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def fn(*vs):
+        vs = [v if v.ndim == 2 else jnp.atleast_2d(v) for v in vs]
+        r = sum(v.shape[0] for v in vs)
+        c = sum(v.shape[1] for v in vs)
+        out = jnp.zeros((r, c), jnp.result_type(*vs))
+        ro = co = 0
+        for v in vs:
+            out = out.at[ro:ro + v.shape[0], co:co + v.shape[1]].set(
+                v.astype(out.dtype))
+            ro += v.shape[0]
+            co += v.shape[1]
+        return out
+
+    return apply(fn, *ts, op_name="block_diag")
+
+
 __all__ += ["hstack", "permute", "tensor_split", "select_scatter",
-            "shard_index"]
+            "shard_index", "slice_scatter", "as_strided",
+            "diagonal_scatter", "column_stack", "row_stack",
+            "cartesian_prod", "block_diag"]

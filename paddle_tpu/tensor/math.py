@@ -97,6 +97,7 @@ fmax = _binary(jnp.fmax, "fmax")
 fmin = _binary(jnp.fmin, "fmin")
 atan2 = _binary(jnp.arctan2, "atan2")
 hypot = _binary(jnp.hypot, "hypot")
+positive = _unary(jnp.positive, "positive")
 logaddexp = _binary(jnp.logaddexp, "logaddexp")
 heaviside = _binary(jnp.heaviside, "heaviside")
 copysign = _binary(jnp.copysign, "copysign")

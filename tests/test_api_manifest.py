@@ -1,0 +1,54 @@
+"""The checked-in API manifests are the auditable form of COVERAGE.md's
+surface claims (round-4 verdict #8): every name listed in
+tests/manifests/*.txt must exist and be callable. Regenerate manifests
+with scripts/gen_api_manifest.py when intentionally extending the
+surface; anything that silently disappears fails here."""
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+
+MANIFEST_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "manifests")
+
+NAMESPACES = {
+    "top_level.txt": lambda: paddle,
+    "nn_functional.txt": lambda: paddle.nn.functional,
+    "nn_layers.txt": lambda: paddle.nn,
+    "linalg.txt": lambda: paddle.linalg,
+    "fft.txt": lambda: paddle.fft,
+    "sparse.txt": lambda: paddle.sparse,
+    "incubate_functional.txt": lambda: paddle.incubate.nn.functional,
+}
+
+
+def _names(fname):
+    with open(os.path.join(MANIFEST_DIR, fname)) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+@pytest.mark.parametrize("fname", sorted(NAMESPACES))
+def test_manifest_names_present_and_callable(fname):
+    ns = NAMESPACES[fname]()
+    missing = [n for n in _names(fname)
+               if not callable(getattr(ns, n, None))]
+    assert not missing, (
+        f"{fname}: {len(missing)} manifest names missing/not callable: "
+        f"{missing[:10]}")
+
+
+def test_manifest_counts_match_coverage_doc():
+    """COVERAGE.md's surface numbers are generated, not hand-maintained:
+    the doc must cite exactly the manifest sizes and the live registry
+    count."""
+    counts = {f: len(_names(f)) for f in NAMESPACES}
+    doc = open(os.path.join(os.path.dirname(MANIFEST_DIR), os.pardir,
+                            "COVERAGE.md")).read()
+    for f, n in counts.items():
+        token = f"{n} ({f.replace('.txt', '')} manifest)"
+        assert token in doc, (
+            f"COVERAGE.md out of date: expected the literal token "
+            f"'{token}' — rerun scripts/gen_api_manifest.py and update")
+    assert f"{len(paddle.OP_REGISTRY)} registry names" in doc, (
+        f"COVERAGE.md registry count != {len(paddle.OP_REGISTRY)}")
