@@ -1,27 +1,64 @@
-"""paddle.distributed.rpc — API-shaped facade (reference:
+"""paddle.distributed.rpc — cross-process RPC (reference:
 python/paddle/distributed/rpc/ over brpc — unverified, SURVEY.md §2.3
 RPC row).
 
-Scope decision (recorded in COVERAGE.md): the reference's rpc utility
-exists to move Python closures between trainer processes for
-parameter-server-style workloads. A TPU training/serving stack is
-single-controller (or SPMD multi-controller) — there is no brpc fabric
-and cross-host Python RPC is a non-goal. This facade keeps the API
-importable and genuinely functional within a process (local execution,
-async via a thread pool); cross-process calls raise with guidance
-rather than pretending.
+TPU-native mechanics: where the reference rides a brpc fabric, this
+implementation uses plain TCP with length-prefixed pickle frames — the
+master endpoint (rank 0) runs a tiny registry server; every worker runs
+an execution server on an ephemeral port and registers (name, rank, ip,
+port). ``rpc_sync``/``rpc_async`` to a remote worker pickle
+``(fn, args, kwargs)``, execute on a connection-handler thread of the
+callee, and stream the pickled result back. Same-process calls take a
+direct fast path. ``shutdown()`` is collective (reference parity): a
+worker keeps serving until every peer has deregistered.
+
+Trust model matches the reference's brpc deployment: the RPC fabric is
+for processes of ONE training job on a private network — frames are
+pickled Python and must never be exposed to untrusted peers.
 """
 from __future__ import annotations
 
+import os
+import pickle
+import socket
+import socketserver
+import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor, Future
-
-import jax
 
 __all__ = [
     "init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
     "get_all_worker_infos", "get_current_worker_info", "WorkerInfo",
 ]
+
+_FRAME = struct.Struct("!Q")
+
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(_FRAME.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _roundtrip(addr, obj, timeout):
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        _send_frame(sock, obj)
+        return _recv_frame(sock)
 
 
 class WorkerInfo:
@@ -42,22 +79,142 @@ class _RpcState:
         self.workers: dict[str, WorkerInfo] = {}
         self.current: WorkerInfo | None = None
         self.pool: ThreadPoolExecutor | None = None
+        self.server = None
+        self.server_thread = None
+        self.master = None          # registry server (rank 0 only)
+        self.master_thread = None
+        self.master_addr = None     # (ip, port) of the registry
+        self.world_size = 1
 
 
 _state = _RpcState()
 
 
+# -- registry (master endpoint, rank 0) --------------------------------------
+class _Registry(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        self.table: dict[str, tuple] = {}
+        self.done: set[str] = set()
+        self.table_lock = threading.Lock()
+        super().__init__(addr, _RegistryHandler)
+
+
+class _RegistryHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            msg = _recv_frame(self.request)
+        except Exception:
+            return
+        reg: _Registry = self.server
+        if msg[0] == "register":
+            _, name, rank, ip, port = msg
+            with reg.table_lock:
+                reg.table[name] = (name, rank, ip, port)
+            _send_frame(self.request, ("ok",))
+        elif msg[0] == "table":
+            with reg.table_lock:
+                _send_frame(self.request, ("table", list(reg.table.values())))
+        elif msg[0] == "done":
+            # shutdown barrier: registrations stay (a slow peer may still
+            # be mid-rendezvous); "done" is a separate generation marker
+            with reg.table_lock:
+                reg.done.add(msg[1])
+                n = len(reg.done)
+            _send_frame(self.request, ("done_count", n))
+        elif msg[0] == "done_count":
+            with reg.table_lock:
+                _send_frame(self.request, ("done_count", len(reg.done)))
+
+
+# -- per-worker execution server ---------------------------------------------
+class _ExecServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _ExecHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            msg = _recv_frame(self.request)
+        except Exception:
+            return
+        try:
+            fn, args, kwargs = msg
+            result = fn(*args, **kwargs)
+            _send_frame(self.request, ("ok", result))
+        except BaseException as e:  # ship the failure back to the caller
+            _send_frame(self.request, ("err", e))
+
+
+def _parse_endpoint(ep):
+    host, port = ep.rsplit(":", 1)
+    return host, int(port)
+
+
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
-    """Register this process as an rpc worker. Single-process (or one
-    worker per launched process) only — see the module docstring."""
+    """Register this process as an rpc worker.
+
+    ``master_endpoint`` ("ip:port") names the registry; rank 0 binds it.
+    Single-process usage (no master_endpoint / world_size 1) skips the
+    network entirely and behaves like the old local facade.
+    """
     with _state.lock:
-        rank = jax.process_index() if rank is None else int(rank)
-        info = WorkerInfo(name, rank)
-        _state.workers[name] = info
-        _state.current = info
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if world_size is None:
+            world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         if _state.pool is None:
             _state.pool = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="paddle-rpc")
+        info = WorkerInfo(name, int(rank))
+        _state.world_size = int(world_size)
+        networked = master_endpoint is not None and int(world_size) > 1
+        if networked:
+            # execution server on an ephemeral port
+            _state.server = _ExecServer(("0.0.0.0", 0), _ExecHandler)
+            _state.server_thread = threading.Thread(
+                target=_state.server.serve_forever, daemon=True)
+            _state.server_thread.start()
+            info.ip = os.environ.get("POD_IP", "127.0.0.1")
+            info.port = _state.server.server_address[1]
+            master_addr = _parse_endpoint(master_endpoint)
+            _state.master_addr = master_addr
+            if int(rank) == 0:
+                _state.master = _Registry(
+                    (master_addr[0], master_addr[1]))
+                _state.master_thread = threading.Thread(
+                    target=_state.master.serve_forever, daemon=True)
+                _state.master_thread.start()
+            # register (retry while the master comes up), then wait for
+            # the full table — init_rpc is a collective, like the
+            # reference's rendezvous
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    _roundtrip(master_addr, (
+                        "register", name, info.rank, info.ip, info.port), 5)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            while True:
+                _, rows = _roundtrip(master_addr, ("table",), 5)
+                if len(rows) >= int(world_size):
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rpc rendezvous: {len(rows)}/{world_size} workers "
+                        f"registered within 60s")
+                time.sleep(0.1)
+            _state.workers = {
+                r[0]: WorkerInfo(*r) for r in rows
+            }
+        _state.workers[name] = info
+        _state.current = info
     return info
 
 
@@ -67,31 +224,46 @@ def _resolve(to):
     if isinstance(to, WorkerInfo):
         to = to.name
     info = _state.workers.get(to)
+    if info is None and _state.master_addr is not None:
+        # late registration — refresh the table once (registry may
+        # already be gone; that is still just an unknown worker)
+        try:
+            _, rows = _roundtrip(_state.master_addr, ("table",), 5)
+        except OSError:
+            rows = []
+        with _state.lock:
+            _state.workers.update({r[0]: WorkerInfo(*r) for r in rows})
+        info = _state.workers.get(to)
     if info is None:
         raise RuntimeError(
-            f"unknown rpc worker {to!r}; cross-process rpc is a non-goal "
-            "on the TPU stack (no brpc fabric) — use "
-            "paddle.distributed collectives or a real RPC system"
-        )
-    if info.rank != _state.current.rank:
-        raise NotImplementedError(
-            "cross-process paddle.distributed.rpc is a documented "
-            "non-goal on the TPU stack; collectives cover SPMD "
-            "communication (see COVERAGE.md)"
-        )
+            f"unknown rpc worker {to!r} (known: "
+            f"{sorted(_state.workers)})")
     return info
 
 
+def _call(info, fn, args, kwargs, timeout):
+    # identity, not rank: duplicate ranks (misconfigured env) must not
+    # silently execute a "remote" call in the caller's process
+    if info.name == _state.current.name:
+        return fn(*(args or ()), **(kwargs or {}))
+    # paddle sentinel: timeout <= 0 means "default", never "instant"
+    timeout = timeout if timeout and timeout > 0 else 120
+    status, payload = _roundtrip(
+        (info.ip, info.port), (fn, args or (), kwargs or {}), timeout)
+    if status == "err":
+        raise payload
+    return payload
+
+
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
-    """Run ``fn`` on worker ``to`` and return its result (local-only)."""
-    _resolve(to)
-    return fn(*(args or ()), **(kwargs or {}))
+    """Run ``fn`` on worker ``to`` and return its result."""
+    return _call(_resolve(to), fn, args, kwargs, timeout)
 
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> Future:
     """Async variant; returns a Future with .result()/.wait()."""
-    _resolve(to)
-    fut = _state.pool.submit(fn, *(args or ()), **(kwargs or {}))
+    info = _resolve(to)
+    fut = _state.pool.submit(_call, info, fn, args, kwargs, timeout)
     if not hasattr(fut, "wait"):
         fut.wait = fut.result  # paddle's handle spells it wait()
     return fut
@@ -99,11 +271,35 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> Future:
 
 def shutdown():
     with _state.lock:
+        if _state.master_addr is not None and _state.current is not None:
+            # collective semantics (reference parity): mark done, then
+            # keep our exec server up until EVERY worker is done — a
+            # peer may still have calls in flight to us
+            try:
+                _, n = _roundtrip(_state.master_addr,
+                                  ("done", _state.current.name), 5)
+                deadline = time.monotonic() + 30
+                while (n < _state.world_size
+                       and time.monotonic() < deadline):
+                    time.sleep(0.1)
+                    _, n = _roundtrip(_state.master_addr,
+                                      ("done_count",), 5)
+            except OSError:
+                pass  # registry already gone — nothing to wait for
+        if _state.server is not None:
+            _state.server.shutdown()
+            _state.server.server_close()
+            _state.server = None
+        if _state.master is not None:
+            _state.master.shutdown()
+            _state.master.server_close()
+            _state.master = None
         if _state.pool is not None:
             _state.pool.shutdown(wait=True)
             _state.pool = None
         _state.workers.clear()
         _state.current = None
+        _state.master_addr = None
 
 
 def get_worker_info(name):
@@ -111,7 +307,7 @@ def get_worker_info(name):
 
 
 def get_all_worker_infos():
-    return list(_state.workers.values())
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
 
 
 def get_current_worker_info():
