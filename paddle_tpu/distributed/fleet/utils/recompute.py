@@ -12,7 +12,27 @@ from ....core.tensor import Tensor
 from ....core import autograd
 from ....core.dispatch import apply
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "should_remat_layer"]
+
+
+def should_remat_layer(config, layer_idx,
+                       block_granularities=("full", "selective"),
+                       allowed=("full", "selective")):
+    """Single source of the block-level remat policy shared by the model
+    families: validates ``config.recompute_granularity`` against
+    ``allowed`` and answers whether layer ``layer_idx`` should be
+    wrapped in recompute(). "selective" remats every other layer (~half
+    the activation memory for half of "full"'s recompute FLOPs)."""
+    gran = getattr(config, "recompute_granularity", "full")
+    if config.use_recompute and gran not in allowed:
+        raise ValueError(
+            f"recompute_granularity must be one of {'/'.join(allowed)}, "
+            f"got {gran!r}")
+    if not config.use_recompute or gran not in block_granularities:
+        return False
+    if gran == "selective":
+        return layer_idx % 2 == 0
+    return True
 
 
 def recompute(function, *args, **kwargs):

@@ -20,7 +20,8 @@ class GPTConfig:
                  num_hidden_layers=24, num_attention_heads=16,
                  intermediate_size=None, max_position_embeddings=1024,
                  layer_norm_epsilon=1e-5, dropout=0.0,
-                 tensor_parallel=False, dtype="float32"):
+                 tensor_parallel=False, use_recompute=False,
+                 recompute_granularity="full", dtype="float32"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -30,6 +31,8 @@ class GPTConfig:
         self.layer_norm_epsilon = layer_norm_epsilon
         self.dropout = dropout
         self.tensor_parallel = tensor_parallel
+        self.use_recompute = use_recompute
+        self.recompute_granularity = recompute_granularity
         self.dtype = dtype
 
     @property
@@ -132,8 +135,15 @@ class GPTModel(Layer):
         s = input_ids.shape[1]
         pos = paddle.arange(s).unsqueeze(0)
         hidden = self.wte(input_ids) + self.wpe(pos)
-        for blk in self.blocks:
-            hidden = blk(hidden)
+        from ..distributed.fleet.utils.recompute import (
+            recompute, should_remat_layer,
+        )
+
+        for i, blk in enumerate(self.blocks):
+            if should_remat_layer(self.config, i):
+                hidden = recompute(blk.forward, hidden)
+            else:
+                hidden = blk(hidden)
         return self.ln_f(hidden)
 
 

@@ -385,24 +385,15 @@ class LlamaModel(Layer):
             position_ids = packed_position_ids(
                 cu_seqlens, int(input_ids.shape[1]))
         new_caches = [] if caches is not None else None
-        gran = self.config.recompute_granularity
-        if self.config.use_recompute and gran not in (
-            "full", "full_attn", "core_attn", "selective",
-        ):
-            raise ValueError(
-                f"recompute_granularity must be one of full/full_attn/"
-                f"core_attn/selective, got {gran!r}"
-            )
+        from ..distributed.fleet.utils.recompute import should_remat_layer
+
         for i, layer in enumerate(self.layers):
             cache_i = caches[i] if caches is not None else None
-            do_remat = (self.config.use_recompute and caches is None
-                        and gran in ("full", "selective"))
-            if do_remat and gran == "selective":
-                # every-other-layer full remat: ~half the activation
-                # memory for half of "full"'s recompute FLOPs (this
-                # framework's extension; PaddleNLP granularities are
-                # full/full_attn/core_attn)
-                do_remat = (i % 2 == 0)
+            # full_attn/core_attn remat happens inside the decoder layer;
+            # block-level remat (full/selective) only without caches
+            do_remat = caches is None and should_remat_layer(
+                self.config, i,
+                allowed=("full", "full_attn", "core_attn", "selective"))
             if do_remat:
                 from ..distributed.fleet.utils.recompute import recompute
 

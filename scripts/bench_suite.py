@@ -76,12 +76,18 @@ def gpt2_jit():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # round-4 lever (round-3 verdict weak #5): B16 + selective remat
+        # beats the old B8/no-remat 31.9% at 40.1% MFU — h1024's narrow
+        # matmuls want batch, and every-other-layer remat buys the HBM
+        # for it (B24/B32 OOM even rematted; measured sweep in
+        # BENCH_NOTES)
         cfg = GPTConfig(
             vocab_size=50304, hidden_size=1024, num_hidden_layers=24,
             num_attention_heads=16, intermediate_size=4096,
-            max_position_embeddings=1024,
+            max_position_embeddings=1024, use_recompute=True,
+            recompute_granularity="selective",
         )
-        batch, seq = 8, 1024
+        batch, seq = 16, 1024
     else:
         cfg = GPTConfig.tiny()
         batch, seq = 2, 32
@@ -101,14 +107,16 @@ def gpt2_jit():
     step = JittedTrainStep(model, crit, opt)
     n = sum(int(np.prod(p._value.shape))
             for _, p in model.named_parameters())
-    ids = paddle.to_tensor(
-        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)))
+    K = 10 if on_tpu else 2  # chained steps cancel dispatch overhead
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (K, batch, seq)))
     flops = transformer_train_flops(
-        n, batch * seq, num_layers=cfg.num_hidden_layers, seq_len=seq,
+        n, K * batch * seq, num_layers=cfg.num_hidden_layers, seq_len=seq,
         hidden=cfg.hidden_size, causal=True)
-    meter = MFUMeter(flops, batch * seq)
-    res = meter.measure(lambda: step(ids, ids), warmup=2,
-                        iters=5 if on_tpu else 2)
+    meter = MFUMeter(flops, K * batch * seq)
+    res = meter.measure(lambda: step.run_steps(ids, ids), warmup=1,
+                        iters=3 if on_tpu else 2)
+    res["step_time_s"] /= K
     out = {"metric": "gpt2_345m_jit_tokens_per_sec",
            "value": round(res["tokens_per_sec"], 1), "unit": "tok/s",
            "params_m": round(n / 1e6)}
