@@ -7,12 +7,13 @@ line per config; results are recorded in BENCH_NOTES.md.
     PYTHONPATH=. python scripts/bench_suite.py [config ...]
 
 Configs: resnet50_eager | resnet50_jit | gpt2_jit | ernie_engine |
-sd_unet | llama_decode | llama_941m_train | llama_941m_packed_train |
-llama_7b_shape_train
+sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
+llama_941m_packed_train | llama_7b_shape_train |
+llama_7b_shape_longctx | moe_dispatch
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
-rounds-1..3 headline config, and llama_941m_packed_train the ragged
-packed-varlen path)
+rounds-1..3 headline config, llama_941m_packed_train the ragged
+packed-varlen path, llama_7b_shape_longctx the S=16k long-context row)
 """
 from __future__ import annotations
 
@@ -465,6 +466,47 @@ def llama_941m_packed_train():
         tokens_per_sec_per_chip=round(res["tokens_per_sec_per_chip"]))
 
 
+def llama_7b_shape_longctx():
+    """Long-context training at 7B shape on ONE chip (SURVEY §5
+    long-context row, measured): L=4 x h4096/d128, S=16384 with
+    attention-only remat (S=32768 exceeds 16G even full-remat; the
+    multi-chip escape hatch is ring/Ulysses CP over the sep axis,
+    parallel==serial-tested on the virtual mesh)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig
+    from paddle_tpu.profiler.mfu import MFUMeter, transformer_train_flops
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    seq = 16384 if on_tpu else 128
+    cfg = LlamaConfig(
+        vocab_size=32000 if on_tpu else 128,
+        hidden_size=4096 if on_tpu else 64,
+        intermediate_size=11008 if on_tpu else 128,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=32 if on_tpu else 4,
+        max_position_embeddings=seq, tensor_parallel=False,
+        use_recompute=True, recompute_granularity="core_attn",
+    )
+    model, step, _ = _bench().build_step(
+        cfg, 1, seq, moment_dtype="bfloat16" if on_tpu else "float32")
+    n = _bench().count_params(model)
+    K = 5 if on_tpu else 2
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (K, 1, seq)))
+    flops = transformer_train_flops(
+        n, K * seq, num_layers=cfg.num_hidden_layers, seq_len=seq,
+        hidden=cfg.hidden_size, causal=True)
+    meter = MFUMeter(flops, K * seq)
+    res = meter.measure(lambda: step.run_steps(ids, ids), warmup=1,
+                        iters=3 if on_tpu else 2)
+    res["step_time_s"] /= K
+    return _mfu_row(
+        "llama_7b_shape_16k_longctx_train_mfu", res, seq=seq,
+        params_m=round(n / 1e6),
+        tokens_per_sec_per_chip=round(res["tokens_per_sec_per_chip"]))
+
+
 def moe_dispatch():
     """MoE dispatch tiers head-to-head (round-4 verdict #4): grouped
     sort+`lax.ragged_dot` vs dense GShard (T,E,C) einsum, fwd+bwd+SGD
@@ -595,6 +637,7 @@ CONFIGS = {
     "llama_941m_train": llama_941m_train,
     "llama_941m_packed_train": llama_941m_packed_train,
     "llama_7b_shape_train": llama_7b_shape_train,
+    "llama_7b_shape_longctx": llama_7b_shape_longctx,
     "moe_dispatch": moe_dispatch,
 }
 
