@@ -434,16 +434,44 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    """Eager p2p send. In a 2-process job the src/dst pair IS the whole
+    world, so the pair can ride the compiled collective substrate (src
+    contributes the payload, the peer zeros; the sum is the message).
+    Larger worlds would stall the non-participating ranks — raise."""
+    if jax.process_count() == 2:
+        if int(dst) == jax.process_index():
+            raise ValueError(
+                f"send: dst {dst} is this process — a self-send would "
+                f"deadlock the pairwise collective")
+        t = _ensure_tensor(tensor)
+        _cross_process_collective(t._value, "sum")
+        return _maybe_task(t, sync_op)
     raise NotImplementedError(
-        "point-to-point eager send/recv has no single-controller analog; "
-        "pipeline parallelism uses per-stage device placement instead"
+        "eager send/recv is supported only for 2-process jobs (the pair "
+        "is the whole world); at larger world sizes point-to-point has "
+        "no single-controller analog — pipeline parallelism uses "
+        "per-stage device placement instead"
     )
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    """Eager p2p recv — see send(); the receiver contributes zeros."""
+    if jax.process_count() == 2:
+        import jax.numpy as jnp
+
+        if int(src) == jax.process_index():
+            raise ValueError(
+                f"recv: src {src} is this process — a self-recv would "
+                f"deadlock the pairwise collective")
+        t = _ensure_tensor(tensor)
+        t._value = _cross_process_collective(
+            jnp.zeros_like(t._value), "sum")
+        return _maybe_task(t, sync_op)
     raise NotImplementedError(
-        "point-to-point eager send/recv has no single-controller analog; "
-        "pipeline parallelism uses per-stage device placement instead"
+        "eager send/recv is supported only for 2-process jobs (the pair "
+        "is the whole world); at larger world sizes point-to-point has "
+        "no single-controller analog — pipeline parallelism uses "
+        "per-stage device placement instead"
     )
 
 

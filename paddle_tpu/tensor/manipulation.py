@@ -441,7 +441,12 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
         inv = np.cumsum(keep) - 1
         counts = np.diff(np.append(np.nonzero(keep)[0], len(xv)))
     else:
-        raise NotImplementedError("unique_consecutive with axis")
+        xs = np.moveaxis(xv, axis, 0)
+        keep = np.ones(xs.shape[0], dtype=bool)
+        keep[1:] = np.any(xs[1:] != xs[:-1], axis=tuple(range(1, xs.ndim)))
+        vals = np.moveaxis(xs[keep], 0, axis)
+        inv = np.cumsum(keep) - 1
+        counts = np.diff(np.append(np.nonzero(keep)[0], xs.shape[0]))
     outs = [Tensor(jnp.asarray(vals))]
     if return_inverse:
         outs.append(Tensor(jnp.asarray(inv).astype(to_jax_dtype(dtype))))

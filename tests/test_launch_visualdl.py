@@ -191,6 +191,14 @@ def test_two_process_rendezvous_and_collective(tmp_path):
         "dist.all_gather_object(objs, {'rank': rank, 'pad': 'x' * (rank * 50)})\n"
         "print('OBJ', rank, [o['rank'] for o in objs],"
         " [len(o['pad']) for o in objs])\n"
+        # p2p send/recv: the 2-process pair rides the collective
+        "pt = paddle.to_tensor(np.asarray([41.0 + rank], 'f4'))\n"
+        "if rank == 0:\n"
+        "    dist.send(pt, dst=1)\n"
+        "    print('SENT', rank)\n"
+        "else:\n"
+        "    dist.recv(pt, src=0)\n"
+        "    print('RECV', rank, float(np.asarray(pt._value)[0]))\n"
     )
     try:
         r = _launch(tmp_path, body,
@@ -221,6 +229,8 @@ def test_two_process_rendezvous_and_collective(tmp_path):
     assert "GATHERDST 1 [7.0, 14.0]" in out
     # all_gather_object with unequal pickled sizes
     assert "OBJ 0 [0, 1] [0, 50]" in out and "OBJ 1 [0, 1] [0, 50]" in out
+    # p2p: rank1 received rank0's 41.0 (its own value was 42.0)
+    assert "SENT 0" in out and "RECV 1 41.0" in out
 
 
 def test_two_process_rpc(tmp_path):

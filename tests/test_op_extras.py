@@ -617,3 +617,18 @@ class TestClassCenterSample(OpTest):
         r2, s2 = F.class_center_sample(lab2, num_classes=20, num_samples=4)
         np.testing.assert_array_equal(np.sort(s2.numpy()), np.arange(10))
         assert (s2.numpy()[r2.numpy()] == np.arange(10)).all()
+
+
+class TestUniqueConsecutiveAxis(OpTest):
+    def test_unique_consecutive_axis(self):
+        x = np.asarray([[1, 2], [1, 2], [3, 4], [3, 4], [1, 2]], "i8")
+        vals, inv, counts = paddle.unique_consecutive(
+            _t(x), return_inverse=True, return_counts=True, axis=0)
+        np.testing.assert_array_equal(
+            vals.numpy(), [[1, 2], [3, 4], [1, 2]])
+        np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 2])
+        np.testing.assert_array_equal(counts.numpy(), [2, 2, 1])
+        # axis=1
+        y = np.asarray([[1, 1, 2], [3, 3, 4]], "i8")
+        v2 = paddle.unique_consecutive(_t(y), axis=1)
+        np.testing.assert_array_equal(v2.numpy(), [[1, 2], [3, 4]])
