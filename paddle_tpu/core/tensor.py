@@ -60,7 +60,7 @@ class Tensor:
         "_grad_hooks",
         "_retain_grad_flag",
         "persistable",
-        "trainable",
+        "_trainable_override",
         "__weakref__",
         "__dict__",
     )
@@ -74,7 +74,7 @@ class Tensor:
         self._grad_hooks = []
         self._retain_grad_flag = False
         self.persistable = False
-        self.trainable = not stop_gradient
+        self._trainable_override = None
 
     # -- meta ---------------------------------------------------------------
     @property
@@ -120,6 +120,19 @@ class Tensor:
     @stop_gradient.setter
     def stop_gradient(self, v):
         self._stop_gradient = bool(v)
+
+    @property
+    def trainable(self):
+        """Tracks stop_gradient (paddle semantics: flipping
+        stop_gradient later must change what optimizers update) unless
+        explicitly overridden via the setter (frozen Parameters)."""
+        if self._trainable_override is not None:
+            return self._trainable_override
+        return not self._stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self._trainable_override = bool(v)
 
     @property
     def is_tensor(self):
@@ -376,7 +389,8 @@ class Parameter(Tensor):
     def __init__(self, value, dtype=None, name=None, trainable=True):
         super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
         self.persistable = True
-        self.trainable = trainable
+        # trainable tracks stop_gradient (no override): freezing a param
+        # later via p.stop_gradient = True must stop optimizer updates
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
@@ -403,7 +417,7 @@ def _tensor_unflatten(aux, children):
     obj._grad_hooks = []
     obj._retain_grad_flag = False
     obj.persistable = False
-    obj.trainable = not stop_gradient
+    obj._trainable_override = None  # trainable keeps tracking stop_gradient
     return obj
 
 

@@ -384,17 +384,88 @@ def poisson_nll_loss(input, label, log_input=True, full=False,
                  op_name="poisson_nll_loss")
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _hsigmoid_default_paths(num_classes):
+    """Complete-binary-tree paths (heap layout): internal nodes
+    0..num_classes-2 (root 0, children of i at 2i+1/2i+2), leaf of
+    class c at heap id num_classes-1+c. Returns (paths, codes) of shape
+    (num_classes, depth), padded with -1; code 1 = right child."""
+    import numpy as _np
+
+    n = int(num_classes)
+    depth = max(1, int(_np.ceil(_np.log2(max(n, 2)))))
+    paths = -_np.ones((n, depth), _np.int32)
+    codes = _np.zeros((n, depth), _np.int32)
+    for c in range(n):
+        node = n - 1 + c  # leaf heap id
+        chain = []
+        while node != 0:
+            parent = (node - 1) // 2
+            chain.append((parent, 1 if node == 2 * parent + 2 else 0))
+            node = parent
+        for j, (p, code) in enumerate(reversed(chain)):
+            paths[c, j] = p
+            codes[c, j] = code
+    return paths, codes
+
+
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False,
                   name=None):
-    """Intentionally unimplemented (raises): hierarchical softmax is
-    PS-era sparse-training machinery with no TPU win — use
-    cross_entropy (full softmax beats tree traversal on the MXU)."""
-    raise NotImplementedError(
-        "hsigmoid_loss: custom-tree hierarchical softmax is PS-era "
-        "sparse-training machinery; use cross_entropy (full softmax on "
-        "TPU is faster than tree traversal at these vocab sizes)"
-    )
+    """Hierarchical sigmoid loss (reference:
+    python/paddle/nn/functional/loss.py hsigmoid_loss — unverified,
+    SURVEY.md §0). input (N, D); weight (num_classes-1, D) for the
+    default complete binary tree, or (num_nodes, D) with explicit
+    ``path_table``/``path_code`` (N, L) — entries < 0 are padding.
+    Per-sample loss = sum over path nodes of BCE-with-logits
+    (code 1 = right child). Returns (N, 1)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    args = [input, label, weight]
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        args.append(bias)
+    custom = path_table is not None
+    if custom:
+        if path_code is None:
+            raise ValueError("hsigmoid_loss: path_table needs path_code")
+        args += [ensure_tensor(path_table), ensure_tensor(path_code)]
+        default_paths = None
+    else:
+        default_paths = _hsigmoid_default_paths(num_classes)
+
+    def fn(x, lab, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        lab_flat = lab.reshape(-1).astype(jnp.int32)  # paddle allows (N,1)
+        if custom:
+            pt, pc = rest
+            nodes = pt.astype(jnp.int32)
+            codes = pc.astype(jnp.float32)
+        else:
+            paths, codes_np = default_paths
+            nodes = jnp.asarray(paths)[lab_flat]
+            codes = jnp.asarray(codes_np)[lab_flat].astype(jnp.float32)
+        valid = (nodes >= 0).astype(jnp.float32)          # (N, L)
+        safe = jnp.maximum(nodes, 0)
+        wn = w[safe]                                       # (N, L, D)
+        logits = jnp.einsum(
+            "nd,nld->nl", x.astype(jnp.float32),
+            wn.astype(jnp.float32))
+        if b is not None:
+            # paddle documents bias as (num_classes-1, 1); accept 1-D too
+            logits = logits + b.reshape(-1).astype(jnp.float32)[safe]
+        # BCE-with-logits, numerically stable
+        per_node = (jnp.maximum(logits, 0.0) - logits * codes
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return (jnp.sum(per_node * valid, axis=1, keepdims=True)
+                .astype(x.dtype))
+
+    return apply(fn, *args, op_name="hsigmoid_loss")
 
 
 __all__ = [

@@ -11,7 +11,7 @@ __all__ = [
     "CosineEmbeddingLoss", "HingeEmbeddingLoss", "TripletMarginLoss",
     "SigmoidFocalLoss", "CTCLoss", "SoftMarginLoss",
     "MultiLabelSoftMarginLoss", "MultiMarginLoss", "GaussianNLLLoss",
-    "PoissonNLLLoss", "PairwiseDistance",
+    "PoissonNLLLoss", "PairwiseDistance", "HSigmoidLoss",
 ]
 
 
@@ -222,3 +222,34 @@ class PairwiseDistance(Layer):
         # one p-norm implementation lives in linalg.norm
         return norm(x - y + self.epsilon, p=self.p, axis=-1,
                     keepdim=self.keepdim)
+
+
+class HSigmoidLoss(Layer):
+    """paddle.nn.HSigmoidLoss: hierarchical sigmoid over the default
+    complete binary tree (is_custom=False) or caller-supplied
+    path_table/path_code (is_custom=True). Holds the (num_classes-1, D)
+    node weight (num-nodes rows for custom trees are the caller's
+    responsibility via num_classes)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._feature_size = feature_size
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self._is_custom and (path_table is None or path_code is None):
+            raise ValueError(
+                "is_custom HSigmoidLoss needs path_table and path_code")
+        return F.hsigmoid_loss(
+            input, label, self._num_classes, self.weight, bias=self.bias,
+            path_table=path_table if self._is_custom else None,
+            path_code=path_code if self._is_custom else None)

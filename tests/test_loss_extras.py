@@ -119,3 +119,72 @@ def test_pairwise_distance_inf_norm():
     d = nn.PairwiseDistance(p=float("inf"))(
         _t(np.array([[0.0, 0.0]], "f4")), _t(np.array([[3.0, 4.0]], "f4")))
     np.testing.assert_allclose(np.asarray(d._value), [4.0], rtol=1e-4)
+
+
+def test_hsigmoid_loss_custom_path_oracle():
+    """Custom path_table/path_code mode vs a numpy BCE-chain oracle,
+    plus grads into input and weight."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    n, d, nodes = 4, 8, 6
+    x_np = rng.randn(n, d).astype("f4")
+    w_np = rng.randn(nodes, d).astype("f4")
+    b_np = rng.randn(nodes).astype("f4")
+    pt = np.asarray([[0, 1, -1], [0, 2, 4], [0, 1, 3], [0, 2, -1]], "i8")
+    pc = np.asarray([[1, 0, 0], [0, 1, 1], [1, 1, 0], [0, 0, 0]], "i8")
+    lab = np.asarray([0, 1, 2, 3], "i8")
+
+    x = paddle.to_tensor(x_np)
+    x.stop_gradient = False
+    w = paddle.to_tensor(w_np)
+    w.stop_gradient = False
+    out = F.hsigmoid_loss(x, paddle.to_tensor(lab), 4, w,
+                          bias=paddle.to_tensor(b_np),
+                          path_table=paddle.to_tensor(pt),
+                          path_code=paddle.to_tensor(pc))
+    # numpy oracle
+    ref = np.zeros((n, 1), "f4")
+    for i in range(n):
+        for j in range(pt.shape[1]):
+            node = pt[i, j]
+            if node < 0:
+                continue
+            z = float(x_np[i] @ w_np[node] + b_np[node])
+            c = float(pc[i, j])
+            ref[i, 0] += max(z, 0) - z * c + np.log1p(np.exp(-abs(z)))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+    out.sum().backward()
+    assert float(paddle.abs(x.grad).sum()) > 0
+    assert float(paddle.abs(w.grad).sum()) > 0
+
+
+def test_hsigmoid_loss_default_tree():
+    """Default complete-binary-tree mode: every class's path BCE sums;
+    sanity — loss falls as the logit chain is trained toward the codes."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional.loss import _hsigmoid_default_paths
+
+    num_classes, d = 6, 8
+    paths, codes = _hsigmoid_default_paths(num_classes)
+    assert paths.shape[0] == num_classes
+    # every leaf path stays within the internal-node id range
+    assert paths.max() < num_classes - 1 and (paths[paths >= 0] >= 0).all()
+
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(5, d).astype("f4"))
+    lab = paddle.to_tensor(np.asarray([0, 5, 2, 3, 1], "i8"))
+    w = paddle.to_tensor(rng.randn(num_classes - 1, d).astype("f4"))
+    out = F.hsigmoid_loss(x, lab, num_classes, w)
+    assert out.shape == [5, 1] and np.isfinite(out.numpy()).all()
+    # trainable: a few SGD steps on w must reduce the loss
+    w.stop_gradient = False
+    opt = paddle.optimizer.SGD(0.05, parameters=[w])
+    losses = []
+    for _ in range(10):
+        loss = F.hsigmoid_loss(x, lab, num_classes, w).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
