@@ -149,13 +149,16 @@ def _tp_descs():
     ]
 
 
-def test_pp_tp_zero_three_axis_matches_serial():
+@pytest.mark.parametrize("virtual", [None, 2])
+def test_pp_tp_zero_three_axis_matches_serial(virtual):
     """The north-star topology (BASELINE config #3): PP x TP x ZeRO-2
     composed on one 8-device mesh — pp2 stages whose sub-meshes carry
-    mp=2 and sharding=2. Oracle: multi-step losses == mesh-less serial.
-    Also asserts the composition is REAL: TP params live mp-sharded on
-    their stage sub-mesh and optimizer moments are sharded over the
-    sharding axis of the param's own mesh."""
+    mp=2 and sharding=2; virtual=2 adds INTERLEAVED PP (round-robin
+    chunk placement must re-home TP-sharded params per chunk). Oracle:
+    multi-step losses == mesh-less serial. Also asserts the composition
+    is REAL: TP params live mp-sharded on their stage sub-mesh and
+    optimizer moments are sharded over the sharding axis of the param's
+    own mesh."""
     import jax
 
     if len(jax.devices()) < 8:
@@ -191,9 +194,13 @@ def test_pp_tp_zero_three_axis_matches_serial():
     fleet.init(is_collective=True, strategy=strategy)
     paddle.seed(7)
     pipe = PipelineLayer(layers=_tp_descs(), num_stages=2,
-                         loss_fn=nn.CrossEntropyLoss())
+                         loss_fn=nn.CrossEntropyLoss(),
+                         num_virtual_pipeline_stages=virtual)
     model = fleet.distributed_model(pipe)
-    assert isinstance(model, PipelineParallel)
+    # exact type: Interleave subclasses PipelineParallel, so isinstance
+    # would pass vacuously for the plain arm
+    assert type(model) is (
+        PipelineParallelWithInterleave if virtual else PipelineParallel)
     opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters(),
                                  weight_decay=0.01)
     opt = fleet.distributed_optimizer(opt)
@@ -222,3 +229,12 @@ def test_pp_tp_zero_three_axis_matches_serial():
     assert msh.mesh.devices.tolist() == stage_meshes[1].devices.tolist()
     assert any("sharding" in ((e,) if isinstance(e, str) else tuple(e or ()))
                for e in msh.spec if e is not None)
+    if virtual:
+        # the interleave-specific fact: chunk 2 (stage 1's territory
+        # under PLAIN pp2) round-robins back to stage 0 — its TP weight
+        # must be re-homed onto stage 0's sub-mesh
+        items2 = pipe.get_stage_items(2)
+        tp2 = next(it for it in items2 if hasattr(it, "weight")
+                   and getattr(it.weight, "is_distributed", False))
+        assert (tp2.weight._value.sharding.mesh.devices.tolist()
+                == stage_meshes[0].devices.tolist())
