@@ -21,8 +21,9 @@ from .communication.group import Group, new_group, get_group, is_initialized  # 
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
-    "all_reduce", "all_gather", "all_gather_object", "broadcast", "reduce",
-    "scatter", "gather", "barrier", "all_to_all", "send", "recv", "ReduceOp",
+    "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "broadcast_object_list", "reduce", "scatter", "scatter_object_list",
+    "gather", "barrier", "all_to_all", "send", "recv", "ReduceOp",
     "new_group", "get_group", "is_initialized", "spawn", "launch",
     "get_backend", "DataParallel", "fleet", "split", "shard_tensor",
 ]
@@ -350,6 +351,44 @@ def all_gather_object(object_list, obj, group=None):
     del object_list[:]
     object_list.extend(obj for _ in range(n))
     return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast a list of picklables from ``src`` (reference:
+    paddle.distributed.broadcast_object_list) — rides
+    all_gather_object's byte protocol; only RECEIVERS are overwritten
+    (src keeps its original objects, reference identity semantics)."""
+    if jax.process_count() > 1:
+        me = jax.process_index()
+        tmp = []
+        all_gather_object(
+            tmp, list(object_list) if me == int(src) else None)
+        if me != int(src):
+            del object_list[:]
+            object_list.extend(tmp[int(src)])
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Each rank receives in_object_list[rank] from ``src`` (reference:
+    paddle.distributed.scatter_object_list)."""
+    multi = jax.process_count() > 1
+    n = jax.process_count() if multi else max(get_world_size(group), 1)
+    rank = jax.process_index() if multi else get_rank(group)
+    is_src = rank == int(src)
+    items = list(in_object_list or [])
+    if is_src and len(items) != n:
+        raise ValueError(
+            f"scatter_object_list: src must pass world_size={n} "
+            f"objects, got {len(items)}")
+    if multi:
+        full = [items if is_src else None]
+        broadcast_object_list(full, src=src, group=group)
+        items = full[0]
+    del out_object_list[:]
+    out_object_list.append(items[rank])
+    return out_object_list
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):

@@ -191,6 +191,14 @@ def test_two_process_rendezvous_and_collective(tmp_path):
         "dist.all_gather_object(objs, {'rank': rank, 'pad': 'x' * (rank * 50)})\n"
         "print('OBJ', rank, [o['rank'] for o in objs],"
         " [len(o['pad']) for o in objs])\n"
+        # broadcast/scatter of arbitrary objects
+        "bl = [{'cfg': 7, 'tag': 'fromzero'}] if rank == 0 else [None]\n"
+        "dist.broadcast_object_list(bl, src=0)\n"
+        "print('BOBJ', rank, bl[0]['cfg'], bl[0]['tag'])\n"
+        "so = []\n"
+        "dist.scatter_object_list(so, ['r0gets', 'r1gets'] if rank == 0\n"
+        "                         else None, src=0)\n"
+        "print('SOBJ', rank, so[0])\n"
         # p2p send/recv: the 2-process pair rides the collective
         "pt = paddle.to_tensor(np.asarray([41.0 + rank], 'f4'))\n"
         "if rank == 0:\n"
@@ -229,6 +237,9 @@ def test_two_process_rendezvous_and_collective(tmp_path):
     assert "GATHERDST 1 [7.0, 14.0]" in out
     # all_gather_object with unequal pickled sizes
     assert "OBJ 0 [0, 1] [0, 50]" in out and "OBJ 1 [0, 1] [0, 50]" in out
+    # object broadcast/scatter
+    assert "BOBJ 0 7 fromzero" in out and "BOBJ 1 7 fromzero" in out
+    assert "SOBJ 0 r0gets" in out and "SOBJ 1 r1gets" in out
     # p2p: rank1 received rank0's 41.0 (its own value was 42.0)
     assert "SENT 0" in out and "RECV 1 41.0" in out
 
