@@ -64,6 +64,16 @@ def distributed_model(model):
     strategy = _fleet_state["strategy"] or DistributedStrategy()
     hcg = _fleet_state["hcg"]
     hc = strategy.hybrid_configs
+    if strategy.sharding and int(strategy.sharding_configs.get("stage", 1)) == 3:
+        # ZeRO-3: the params THEMSELVES are sharded dim-0 over the
+        # 'sharding' axis (merged with any TP spec, on the param's own
+        # stage sub-mesh) — distributed_optimizer below then co-locates
+        # the optimizer state with the sharded param
+        from .meta_parallel.sharding.group_sharded import (
+            shard_model_params_stage3,
+        )
+
+        shard_model_params_stage3(model)
     if int(hc["pp_degree"]) > 1:
         if getattr(model, "_num_virtual", 1) > 1:
             from .meta_parallel.pipeline_parallel import (

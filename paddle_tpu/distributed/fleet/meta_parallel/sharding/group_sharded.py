@@ -17,6 +17,7 @@ mesh axis, not graph surgery —
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -32,14 +33,26 @@ def _shard_dim0(value, like=None):
     """Shard dim 0 over the ``sharding`` axis of the mesh that owns
     ``like`` (the param), falling back to the global mesh. Under PP a
     stage-1 param lives on a stage sub-mesh; its optimizer state must be
-    co-located there, not on the global (stage-0) mesh."""
-    mesh = getattr(getattr(like, "sharding", None), "mesh", None)
-    if mesh is not None and "sharding" in getattr(mesh, "shape", {}):
-        from .....parallel.mesh import MeshScope
+    co-located there, not on the global (stage-0) mesh. When ``like``
+    carries its own PartitionSpec (TP layers, or a stage-3-sharded param)
+    and matches ``value``'s shape, the spec is MERGED with the ZeRO axis
+    rather than replaced — composition with TP must not drop the ``mp``
+    placement."""
+    from jax.sharding import NamedSharding
 
-        with MeshScope(mesh):
-            return mesh_state.shard_value(value, "sharding")
-    return mesh_state.shard_value(value, "sharding")
+    like_sh = getattr(like, "sharding", None)
+    mesh = getattr(like_sh, "mesh", None)
+    if mesh is None or "sharding" not in getattr(mesh, "shape", {}):
+        mesh = mesh_state.get_mesh()
+    if mesh is None:
+        return value
+    base = ()
+    if (isinstance(like_sh, NamedSharding)
+            and np.shape(like) == np.shape(value)):
+        base = tuple(like_sh.spec)
+    spec = mesh_state.merged_dim0_spec(
+        np.shape(value), base, mesh, "sharding")
+    return jax.device_put(value, NamedSharding(mesh, spec))
 
 
 def _patch_optimizer_state_sharding(optimizer):
@@ -67,6 +80,26 @@ def _patch_optimizer_state_sharding(optimizer):
 
     optimizer._state_for = state_for
     return optimizer
+
+
+def shard_model_params_stage3(model):
+    """Apply ZeRO-3 param-sharding placement to every param of ``model``:
+    dim 0 gains the ``sharding`` axis (minor, merged with any existing
+    TP spec) on the param's OWN mesh — under PP that is the stage
+    sub-mesh the PipelineLayer homed it to, so stage-3 composes with
+    both PP and TP. XLA all-gathers the shards where the forward needs
+    them and reshards after (the reference's stage-3 pre-fetch/free
+    hooks, compiled)."""
+    for _, p in model.named_parameters():
+        p._value = _shard_dim0(p._value, like=p._value)
+        # flag reflects the actual placement: dim-0 may stay unsharded
+        # (no mesh, or not divisible) and consumers (save/gather logic,
+        # shard-bytes assertions) must not be told otherwise
+        spec = getattr(getattr(p._value, "sharding", None), "spec", ())
+        d0 = spec[0] if spec else None
+        p.is_sharded = "sharding" in (
+            (d0,) if isinstance(d0, str) else tuple(d0 or ()))
+    return model
 
 
 class _ShardedModelWrapper:
@@ -105,9 +138,7 @@ class GroupShardedStage3(_ShardedModelWrapper):
     def __init__(self, layer, optimizer=None, group=None, sync_comm=False,
                  segment_size=2**20, **kwargs):
         super().__init__(layer)
-        for _, p in layer.named_parameters():
-            p._value = _shard_dim0(p._value, like=p._value)
-            p.is_sharded = True
+        shard_model_params_stage3(layer)
 
     def get_all_parameters(self):
         """Gather full params (reference: stage3 all-gather for save)."""

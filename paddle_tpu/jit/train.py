@@ -268,32 +268,15 @@ def _shard_states(states, axis, p_vals):
     if size <= 1:
         return states
 
-    def _entry_size(entry):
-        if entry is None:
-            return 1
-        names = (entry,) if isinstance(entry, str) else tuple(entry)
-        n = 1
-        for nm in names:
-            n *= mesh.shape[nm]
-        return n
-
     def _merged_spec(p, v):
         pspec = ()
         psh = _named_sharding_of(p)
         if psh is not None:
             pspec = tuple(psh.spec)
-        parts = list(pspec) + [None] * (v.ndim - len(pspec))
-        d0 = parts[0]
-        existing = () if d0 is None else (
-            (d0,) if isinstance(d0, str) else tuple(d0))
-        if axis not in existing and v.shape[0] % (size * _entry_size(d0)) == 0:
-            # ZeRO axis goes MINOR (last): a PartitionSpec dim-entry tuple
-            # is major-first, so ('mp', 'sharding') subdivides each mp
-            # chunk — each device's moment shard is a sub-slice of its own
-            # param/grad shard. ('sharding', 'mp') would interleave across
-            # mp chunks and force a cross-device reshard every step.
-            parts[0] = (*existing, axis) if existing else axis
-        return PartitionSpec(*parts)
+        # ZeRO axis goes MINOR on dim 0 (shared rule, see
+        # mesh.merged_dim0_spec): each device's moment shard is a
+        # sub-slice of its own param/grad shard.
+        return mesh_state.merged_dim0_spec(v.shape, pspec, mesh, axis)
 
     out = []
     for p, st in zip(p_vals, states):

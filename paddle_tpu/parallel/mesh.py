@@ -95,6 +95,31 @@ def _divisible(value, spec):
     return True
 
 
+def merged_dim0_spec(shape, base_spec, mesh, axis):
+    """Merge ``axis`` into dim 0 of ``base_spec``, MINOR (last in the
+    dim-entry tuple): for a TP-sharded tensor this subdivides each ``mp``
+    chunk so every device's ZeRO shard is a sub-slice of its own TP
+    shard — ``(axis, 'mp')`` would interleave across mp chunks and force
+    a cross-device reshard every step. Returns the base spec unchanged
+    when dim 0 doesn't divide by the combined axis sizes or ``axis`` is
+    already present. Shared by the ZeRO-1/2 optimizer-state placement
+    (jit/train.py) and the stage-3 param placement (group_sharded.py)."""
+    size = int(mesh.shape.get(axis, 1))
+    ndim = len(shape)
+    if size <= 1 or ndim == 0:
+        return PartitionSpec(*base_spec)
+    parts = list(base_spec) + [None] * (ndim - len(base_spec))
+    d0 = parts[0]
+    existing = () if d0 is None else (
+        (d0,) if isinstance(d0, str) else tuple(d0))
+    existing_size = 1
+    for a in existing:
+        existing_size *= int(mesh.shape.get(a, 1))
+    if axis not in existing and shape[0] % (size * existing_size) == 0:
+        parts[0] = (*existing, axis) if existing else axis
+    return PartitionSpec(*parts)
+
+
 def shard_value(value, *spec):
     """device_put a concrete array with the given PartitionSpec entries
     (falls back to replication for non-divisible dims)."""

@@ -30,6 +30,12 @@ def _descs():
     ]
 
 
+def _spec_axes(spec):
+    """Flatten a PartitionSpec into the mesh-axis names it uses."""
+    return [a for e in spec if e is not None
+            for a in ((e,) if isinstance(e, str) else e)]
+
+
 def _serial_reference(x_np, y_np, steps=3):
     mesh_state.set_mesh(None)
     paddle.seed(7)
@@ -149,16 +155,21 @@ def _tp_descs():
     ]
 
 
-@pytest.mark.parametrize("virtual", [None, 2])
-def test_pp_tp_zero_three_axis_matches_serial(virtual):
-    """The north-star topology (BASELINE config #3): PP x TP x ZeRO-2
-    composed on one 8-device mesh — pp2 stages whose sub-meshes carry
-    mp=2 and sharding=2; virtual=2 adds INTERLEAVED PP (round-robin
-    chunk placement must re-home TP-sharded params per chunk). Oracle:
-    multi-step losses == mesh-less serial. Also asserts the composition
-    is REAL: TP params live mp-sharded on their stage sub-mesh and
-    optimizer moments are sharded over the sharding axis of the param's
-    own mesh."""
+@pytest.mark.parametrize("virtual,stage", [
+    (None, 2), (2, 2), (None, 3), (2, 3),
+])
+def test_pp_tp_zero_three_axis_matches_serial(virtual, stage):
+    """The north-star topology (BASELINE config #3): PP x TP x
+    sharding composed on one 8-device mesh — pp2 stages whose
+    sub-meshes carry mp=2 and sharding=2; virtual=2 adds INTERLEAVED PP
+    (round-robin chunk placement must re-home TP-sharded params per
+    chunk); stage=3 is the literal north-star sharding level — the
+    params THEMSELVES are dim-0 sharded over the sharding axis, merged
+    minor with the TP spec. Oracle: multi-step losses == mesh-less
+    serial. Also asserts the composition is REAL: TP params live
+    mp-sharded on their stage sub-mesh, optimizer moments are sharded
+    over the sharding axis of the param's own mesh, and (stage 3) each
+    device holds ≈ 1/4 of every 2-D TP param (mp2 x sharding2)."""
     import jax
 
     if len(jax.devices()) < 8:
@@ -190,7 +201,7 @@ def test_pp_tp_zero_three_axis_matches_serial(virtual):
     }
     strategy.pipeline_configs = {"accumulate_steps": 2}
     strategy.sharding = True
-    strategy.sharding_configs = {"stage": 2}
+    strategy.sharding_configs = {"stage": stage}
     fleet.init(is_collective=True, strategy=strategy)
     paddle.seed(7)
     pipe = PipelineLayer(layers=_tp_descs(), num_stages=2,
@@ -221,14 +232,22 @@ def test_pp_tp_zero_three_axis_matches_serial(virtual):
                and getattr(it.weight, "is_distributed", False))
     sh = tp1.weight._value.sharding
     assert sh.mesh.devices.tolist() == stage_meshes[1].devices.tolist()
-    assert "mp" in [a for e in sh.spec if e is not None
-                    for a in ((e,) if isinstance(e, str) else e)]
+    assert "mp" in _spec_axes(sh.spec)
+    if stage == 3:
+        # stage-3 fact: the PARAM VALUE is ZeRO-sharded — the sharding
+        # axis appears in its spec and each device holds a quarter
+        # (mp2 x sharding2) of the full weight, on the stage sub-mesh
+        assert "sharding" in _spec_axes(sh.spec)
+        full = int(np.prod(tp1.weight._value.shape))
+        shard_elems = int(np.prod(
+            sh.shard_shape(tp1.weight._value.shape)))
+        assert shard_elems * 4 == full
+        assert getattr(tp1.weight, "is_sharded", False)
     # its moment state is sharded over the sharding axis of the SAME mesh
     st = opt._state_for(tp1.weight)
     msh = st["moment1"].sharding
     assert msh.mesh.devices.tolist() == stage_meshes[1].devices.tolist()
-    assert any("sharding" in ((e,) if isinstance(e, str) else tuple(e or ()))
-               for e in msh.spec if e is not None)
+    assert "sharding" in _spec_axes(msh.spec)
     if virtual:
         # the interleave-specific fact: chunk 2 (stage 1's territory
         # under PLAIN pp2) round-robins back to stage 0 — its TP weight
