@@ -25,10 +25,18 @@ def build_step(cfg, batch, seq, lr=1e-4, moment_dtype="float32"):
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.astype("bfloat16")
-    crit = LlamaPretrainingCriterion()
+    fused = getattr(cfg, "fuse_linear_cross_entropy", False)
+    crit = LlamaPretrainingCriterion(
+        cfg, lm_head=model.lm_head if fused else None)
 
-    def criterion(out, labels):
-        return crit(out.astype("float32"), labels)
+    if fused:
+        # chunked fused lm-head+CE: model returns bf16 hidden; the op
+        # accumulates in f32 — no full logits buffer ever exists
+        def criterion(out, labels):
+            return crit(out, labels)
+    else:
+        def criterion(out, labels):
+            return crit(out.astype("float32"), labels)
 
     opt = paddle.optimizer.AdamW(
         lr, parameters=model.parameters(), weight_decay=0.01,

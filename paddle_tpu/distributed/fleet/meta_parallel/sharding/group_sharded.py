@@ -97,8 +97,7 @@ def shard_model_params_stage3(model):
         # shard-bytes assertions) must not be told otherwise
         spec = getattr(getattr(p._value, "sharding", None), "spec", ())
         d0 = spec[0] if spec else None
-        p.is_sharded = "sharding" in (
-            (d0,) if isinstance(d0, str) else tuple(d0 or ()))
+        p.is_sharded = "sharding" in mesh_state.spec_axes((d0,))
     return model
 
 

@@ -79,23 +79,26 @@ class MoELayer(Layer):
                 f"dispatch_mode must be auto|einsum|grouped, got "
                 f"{dispatch_mode!r}")
         # grouped (sort + lax.ragged_dot) is the perf tier: O(T*k) rows
-        # of matmul instead of the dense (T, E, C) einsums. The einsum
-        # tier remains the EP-sharded path — GSPMD turns its expert-dim
-        # constraints into the all-to-all; the sorted ragged layout has
-        # no static per-device partition for the partitioner to use.
+        # of matmul instead of the dense (T, E, C) einsums. With
+        # expert_axis set it runs the shard_map EP schedule (global gate
+        # + per-shard ragged_dot, see _grouped_ep_fn); einsum remains the
+        # GSPMD fallback for custom gates / non-divisible shapes.
         if dispatch_mode == "auto":
             # custom gate objects only promise the __call__ → (dispatch,
             # combine, cap) contract; grouped needs the sparse
             # topk_assignments form
             dispatch_mode = (
-                "grouped" if axis is None
-                and hasattr(self.gate, "topk_assignments") else "einsum")
-        if dispatch_mode == "grouped" and axis is not None:
+                "grouped" if hasattr(self.gate, "topk_assignments")
+                and (axis is None
+                     or num_experts % mesh_state.mesh_axis_size(axis) == 0)
+                else "einsum")
+        if (dispatch_mode == "grouped" and axis is not None
+                and num_experts % max(
+                    mesh_state.mesh_axis_size(axis), 1) != 0):
             raise ValueError(
-                "dispatch_mode='grouped' is the single-device/local tier;"
-                " EP-sharded experts use the einsum path (GSPMD"
-                " all-to-all)"
-            )
+                f"grouped EP dispatch needs num_experts ({num_experts}) "
+                f"divisible by the {axis!r} axis size "
+                f"({mesh_state.mesh_axis_size(axis)})")
         self.dispatch_mode = dispatch_mode
 
     def _act(self, h):
@@ -142,6 +145,102 @@ class MoELayer(Layer):
             out * sorted_gv[:, None])
         return y.reshape(*lead, cfg.d_model), aux
 
+    def _grouped_ep_fn(self, xv, gw, w1, b1, w2, b2):
+        """Expert-parallel grouped dispatch: a ``shard_map`` schedule over
+        ``expert_axis`` with the same gate/capacity semantics as serial.
+
+        Per device: (1) all-gather the token shard and run the GATE
+        GLOBALLY (capacity queueing depends on global token order — a
+        per-shard gate would diverge from the serial oracle); (2) sort
+        the kept routed rows by expert (identical order on every device)
+        and take this shard's expert segment via a dynamic slice whose
+        STATIC size is the gate-capacity bound ``(E/P) * cap`` — the gate
+        guarantees kept rows per expert ≤ cap, so the slice never
+        truncates; (3) ``lax.ragged_dot`` with the local expert weights;
+        (4) scatter-add into a (T, M) partial and ``psum_scatter`` back
+        to the token owners. Per-device matmul rows scale as T*k*cf/P —
+        the EP compute win the dense (T, E, C) einsum tier lacks at long
+        T (its cost ∝ T², BENCH_NOTES MoE table). Wire is one all-gather
+        + one reduce-scatter of (T, M); swapping the gather/scatter pair
+        for ``lax.ragged_all_to_all`` (row exchange ∝ routed tokens) is
+        the upgrade path once XLA:CPU implements the op — today it would
+        make every CPU-mesh test and the driver dryrun unrunnable."""
+        from .gate import _capacity
+
+        cfg = self
+        mesh = mesh_state.get_mesh()
+        ax = cfg.expert_axis
+        pn = int(mesh.shape[ax])
+        e = cfg.num_experts
+        epp = e // pn
+        lead = xv.shape[:-1]
+        t = 1
+        for s in lead:
+            t *= s
+        k = cfg.gate.top_k
+        cap = _capacity(t, e, cfg.gate.capacity_factor, k)
+        slice_rows = min(epp * cap, t * k)
+        from .....distributed.fleet.meta_parallel.context_parallel import (
+            shard_map,
+        )
+        from jax.sharding import PartitionSpec as P
+
+        def body(xt_loc, gw_, w1_, b1_, w2_, b2_):
+            p = jax.lax.axis_index(ax)
+            xt_all = jax.lax.all_gather(xt_loc, ax, axis=0, tiled=True)
+            logits = xt_all.astype(jnp.float32) @ gw_.astype(jnp.float32)
+            topi, gate_vals, aux = cfg.gate.topk_assignments(logits)
+            expert_flat = topi.reshape(-1)
+            gv_flat = gate_vals.reshape(-1).astype(xt_all.dtype)
+            tok_flat = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
+            kept = gv_flat > 0
+            # dropped rows sort to the sentinel tail: the slice bound
+            # below holds for KEPT rows only
+            key = jnp.where(kept, expert_flat, e).astype(jnp.int32)
+            order = jnp.argsort(key)
+            pad_tail = jnp.full((slice_rows,), e, jnp.int32)
+            sorted_tok = jnp.concatenate(
+                [tok_flat[order], jnp.zeros((slice_rows,), jnp.int32)])
+            sorted_exp = jnp.concatenate([key[order], pad_tail])
+            sorted_gv = jnp.concatenate(
+                [gv_flat[order], jnp.zeros((slice_rows,), gv_flat.dtype)])
+            kept_counts = jnp.bincount(key, length=e + 1)[:e]
+            start = jnp.sum(
+                jnp.where(jnp.arange(e) < p * epp, kept_counts, 0)
+            ).astype(jnp.int32)
+            rows_tok = jax.lax.dynamic_slice(sorted_tok, (start,),
+                                             (slice_rows,))
+            rows_exp = jax.lax.dynamic_slice(sorted_exp, (start,),
+                                             (slice_rows,))
+            rows_gv = jax.lax.dynamic_slice(sorted_gv, (start,),
+                                            (slice_rows,))
+            xs = xt_all[rows_tok]
+            mine = (rows_exp >= p * epp) & (rows_exp < (p + 1) * epp)
+            local_exp = jnp.clip(rows_exp - p * epp, 0, epp - 1)
+            gs = jax.lax.dynamic_slice(
+                kept_counts, (p * epp,), (epp,)).astype(jnp.int32)
+            # trailing non-mine rows feed the last group; masked below
+            gs = gs.at[-1].add(slice_rows - jnp.sum(gs))
+            h = jax.lax.ragged_dot(xs, w1_.astype(xs.dtype), gs)
+            h = h + b1_[local_exp].astype(xs.dtype)
+            h = cfg._act(h)
+            out = jax.lax.ragged_dot(h, w2_.astype(xs.dtype), gs)
+            out = out + b2_[local_exp].astype(xs.dtype)
+            weight = jnp.where(mine, rows_gv, 0.0)
+            y = jnp.zeros((t, cfg.d_model), xs.dtype).at[rows_tok].add(
+                out * weight[:, None])
+            y_loc = jax.lax.psum_scatter(y, ax, scatter_dimension=0,
+                                         tiled=True)
+            return y_loc, jax.lax.pmean(aux, ax)
+
+        xt = xv.reshape(t, cfg.d_model)
+        y, aux = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(ax), P(), P(ax), P(ax), P(ax), P(ax)),
+            out_specs=(P(ax), P()),
+        )(xt, gw, w1, b1, w2, b2)
+        return y.reshape(*lead, cfg.d_model), aux
+
     def forward(self, x):
         """x: (..., d_model) → same shape; self.l_aux holds the aux loss."""
         x = ensure_tensor(x)
@@ -149,10 +248,36 @@ class MoELayer(Layer):
         cfg = self
 
         if self.dispatch_mode == "grouped":
-            out, self.l_aux = apply(
-                self._grouped_fn, x, self.gate_weight, self.w1, self.b1,
-                self.w2, self.b2, op_name="moe_layer_grouped")
-            return out
+            ep = self.expert_axis is not None and mesh_state.has_mesh() \
+                and mesh_state.mesh_axis_size(self.expert_axis) > 1
+            if ep:
+                t = 1
+                for s in x.shape[:-1]:
+                    t *= s
+                pn = mesh_state.mesh_axis_size(self.expert_axis)
+                # the mesh may be installed AFTER construction, so the
+                # num_experts divisibility must be re-checked here too —
+                # inside shard_map it would fail as an opaque in_specs
+                # error on the expert weights
+                if t % pn != 0 or self.num_experts % pn != 0:
+                    import warnings
+
+                    warnings.warn(
+                        f"grouped EP dispatch needs token count {t} and "
+                        f"num_experts {self.num_experts} divisible by "
+                        f"{self.expert_axis}={pn}; falling back to the "
+                        f"einsum tier", RuntimeWarning)
+                else:
+                    out, self.l_aux = apply(
+                        self._grouped_ep_fn, x, self.gate_weight, self.w1,
+                        self.b1, self.w2, self.b2,
+                        op_name="moe_layer_grouped_ep")
+                    return out
+            else:
+                out, self.l_aux = apply(
+                    self._grouped_fn, x, self.gate_weight, self.w1, self.b1,
+                    self.w2, self.b2, op_name="moe_layer_grouped")
+                return out
 
         def fn(xv, gw, w1, b1, w2, b2):
             lead = xv.shape[:-1]

@@ -9,6 +9,9 @@ from __future__ import annotations
 from ....nn.functional.rope import (  # noqa: F401
     fused_rotary_position_embedding,
 )
+from .fused_linear_cross_entropy import (  # noqa: F401
+    fused_linear_cross_entropy,
+)
 from ....nn import functional as _F
 from ....tensor._helpers import ensure_tensor
 
@@ -16,6 +19,7 @@ __all__ = [
     "fused_rotary_position_embedding", "fused_rms_norm", "fused_layer_norm",
     "fused_linear", "fused_bias_act", "fused_multi_head_attention",
     "fused_feedforward", "masked_multihead_attention",
+    "fused_linear_cross_entropy",
 ]
 
 

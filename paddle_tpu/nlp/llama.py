@@ -46,7 +46,8 @@ class LlamaConfig:
                  rope_theta=10000.0, tie_word_embeddings=False,
                  tensor_parallel=True, sequence_parallel=False,
                  context_parallel=None, use_recompute=False,
-                 recompute_granularity="full", dtype="float32"):
+                 recompute_granularity="full", dtype="float32",
+                 fuse_linear_cross_entropy=False, lce_chunk_rows=1024):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -64,6 +65,13 @@ class LlamaConfig:
         self.use_recompute = use_recompute
         self.recompute_granularity = recompute_granularity
         self.dtype = dtype
+        # training-loss fusion: forward() returns the final hidden states
+        # (no lm_head matmul) and LlamaPretrainingCriterion applies the
+        # chunked fused lm-head+CE — full (N, V) logits never exist;
+        # lce_chunk_rows is its scan-chunk size (peak logits bytes =
+        # chunk_rows * vocab * 4)
+        self.fuse_linear_cross_entropy = fuse_linear_cross_entropy
+        self.lce_chunk_rows = lce_chunk_rows
 
     @property
     def head_dim(self):
@@ -428,6 +436,11 @@ class LlamaForCausalLM(Layer):
                 cu_seqlens=None):
         hidden, new_caches = self.llama(input_ids, position_offset, caches,
                                         cu_seqlens)
+        if self.config.fuse_linear_cross_entropy and caches is None:
+            # training-loss fusion: the lm_head matmul happens inside
+            # LlamaPretrainingCriterion's chunked fused op — returning
+            # logits here would defeat the point (full (N, V) buffers)
+            return hidden
         logits = self.lm_head(hidden)
         if caches is not None:
             return logits, new_caches
@@ -449,12 +462,25 @@ class LlamaForCausalLM(Layer):
 
 
 class LlamaPretrainingCriterion(Layer):
-    """Shifted next-token cross entropy (PaddleNLP parity)."""
+    """Shifted next-token cross entropy (PaddleNLP parity).
 
-    def __init__(self, config: LlamaConfig = None):
+    With ``config.fuse_linear_cross_entropy`` the model's forward returns
+    the final HIDDEN states instead of logits and this criterion applies
+    the chunked fused lm-head+CE (``lm_head`` must be passed — kept as a
+    plain attribute, NOT a sublayer, so its params register only on the
+    model). The full (N, V) logits never exist in HBM."""
+
+    def __init__(self, config: LlamaConfig = None, lm_head=None):
         super().__init__()
+        self._fuse = bool(config is not None
+                          and config.fuse_linear_cross_entropy)
+        self._lce_chunk_rows = int(
+            getattr(config, "lce_chunk_rows", 0) or 1024)
+        self.__dict__["_lm_head"] = lm_head
 
     def forward(self, logits, labels, cu_seqlens=None):
+        if self._fuse:
+            return self._fused_forward(logits, labels, cu_seqlens)
         shifted = logits[:, :-1, :]
         targets = labels[:, 1:]
         if cu_seqlens is None:
@@ -487,3 +513,38 @@ class LlamaPretrainingCriterion(Layer):
 
         return apply(masked_mean, per_tok, ensure_tensor(cu_seqlens),
                      op_name="packed_criterion")
+
+    def _fused_forward(self, hidden, labels, cu_seqlens=None):
+        if self._lm_head is None:
+            raise ValueError(
+                "fuse_linear_cross_entropy needs the lm_head: construct "
+                "LlamaPretrainingCriterion(config, lm_head=model.lm_head)")
+        from ..incubate.nn.functional import fused_linear_cross_entropy
+
+        shifted = hidden[:, :-1, :]
+        targets = labels[:, 1:]
+        if cu_seqlens is not None:
+            # packed batch: a segment's last token must not predict the
+            # next segment's first token — those targets become
+            # ignore_index (same positions the unfused packed branch
+            # masks out of its mean)
+            if int(hidden.shape[0]) != 1:
+                raise ValueError(
+                    f"packed cu_seqlens criterion expects batch 1, got "
+                    f"batch {hidden.shape[0]}")
+            import jax.numpy as jnp
+
+            def mask_boundaries(tgt, cu):
+                t = tgt.shape[-1]
+                pos = jnp.arange(t, dtype=jnp.int32)
+                seg_here = jnp.searchsorted(cu, pos, side="right")
+                seg_next = jnp.searchsorted(cu, pos + 1, side="right")
+                return jnp.where(seg_here == seg_next, tgt, -100)
+
+            targets = apply(mask_boundaries, targets,
+                            ensure_tensor(cu_seqlens),
+                            op_name="packed_fused_targets")
+        return fused_linear_cross_entropy(
+            shifted, self._lm_head.weight, targets,
+            bias=getattr(self._lm_head, "bias", None),
+            chunk_rows=self._lce_chunk_rows)

@@ -95,6 +95,17 @@ def _divisible(value, spec):
     return True
 
 
+def spec_axes(spec):
+    """Flatten a PartitionSpec (or spec tuple) into the mesh-axis names
+    it uses, in order; UNCONSTRAINED and None entries contribute none."""
+    out = []
+    for entry in spec:
+        if entry is None or entry is PartitionSpec.UNCONSTRAINED:
+            continue
+        out.extend((entry,) if isinstance(entry, str) else entry)
+    return out
+
+
 def merged_dim0_spec(shape, base_spec, mesh, axis):
     """Merge ``axis`` into dim 0 of ``base_spec``, MINOR (last in the
     dim-entry tuple): for a TP-sharded tensor this subdivides each ``mp``

@@ -1,0 +1,71 @@
+"""7B-shape batch/fusion sweep on the real chip — the B2 HBM-cliff
+attack (round-5). Reuses bench.py's build_step/meter machinery.
+
+Usage: python scripts/bench_7b_sweep.py B FUSED [SEQ] [REMAT]
+  B: batch size; FUSED: 0|1 (fused lm-head+CE); SEQ: default 4096;
+  REMAT: none|core_attn|mlp (default none)
+Prints one JSON line per config.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from bench import build_step, count_params, log  # noqa: E402
+
+
+def run(batch, fused, seq=4096, remat="none", iters=3, K=10):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig
+    from paddle_tpu.profiler.mfu import (
+        MFUMeter, transformer_train_flops,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=4, num_attention_heads=32,
+        max_position_embeddings=max(4096, seq), tensor_parallel=False,
+        use_recompute=remat != "none",
+        recompute_granularity=remat if remat != "none" else "full",
+        fuse_linear_cross_entropy=bool(fused),
+    )
+    import os
+
+    cfg.lce_chunk_rows = int(os.environ.get("LCE_CHUNK", "1024"))
+    model, step, ids = build_step(cfg, batch, seq,
+                                  moment_dtype="bfloat16")
+    n_params = count_params(model)
+    tokens = batch * seq
+    flops = transformer_train_flops(
+        n_params, tokens, num_layers=cfg.num_hidden_layers,
+        seq_len=seq, hidden=cfg.hidden_size, causal=True)
+    ids_stacked = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (K, batch, seq)))
+    t0 = time.perf_counter()
+    meter = MFUMeter(flops * K, tokens * K)
+    res = meter.measure(
+        lambda: step.run_steps(ids_stacked, ids_stacked),
+        warmup=1, iters=iters)
+    res["step_time_s"] /= K
+    log(f"B{batch} fused={fused} seq={seq} remat={remat}: "
+        f"{time.perf_counter()-t0:.0f}s wall")
+    out = {
+        "config": f"B{batch}_S{seq}_fused{int(bool(fused))}_{remat}",
+        "mfu_pct": round(res["mfu"] * 100, 2),
+        "tok_s_chip": round(res["tokens_per_sec_per_chip"]),
+        "step_ms": round(res["step_time_s"] * 1000, 1),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    fused = bool(int(sys.argv[2])) if len(sys.argv) > 2 else True
+    seq = int(sys.argv[3]) if len(sys.argv) > 3 else 4096
+    remat = sys.argv[4] if len(sys.argv) > 4 else "none"
+    run(b, fused, seq, remat)

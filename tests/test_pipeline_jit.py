@@ -30,10 +30,7 @@ def _descs():
     ]
 
 
-def _spec_axes(spec):
-    """Flatten a PartitionSpec into the mesh-axis names it uses."""
-    return [a for e in spec if e is not None
-            for a in ((e,) if isinstance(e, str) else e)]
+from paddle_tpu.parallel.mesh import spec_axes as _spec_axes  # noqa: E402
 
 
 def _serial_reference(x_np, y_np, steps=3):
