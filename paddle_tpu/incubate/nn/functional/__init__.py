@@ -147,7 +147,9 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
     (2, B, S_max, HK, D) stacked k/v caches (paddle layout);
     sequence_lengths: (B,) valid entries incl. the new token;
     src_mask: optional additive bias broadcastable to (B, H, 1, S_max).
-    Quant fusion (out_scale > 0) is not supported here."""
+    out_scale > 0 quantizes the output to int8 inside the op —
+    ``clip(round(out / out_scale), -128, 127)`` (a8w8 serving epilogue;
+    reference applies it in the fused CUDA op — unverified, SURVEY §0)."""
     import jax
     import jax.numpy as jnp
     from ....core.flags import get_flags
@@ -157,11 +159,6 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
         raise ValueError(
             "masked_multihead_attention requires cache_kv and "
             "sequence_lengths"
-        )
-    if out_scale and out_scale > 0:
-        raise NotImplementedError(
-            "masked_multihead_attention: out_scale quant fusion is not "
-            "supported; quantize via paddle.quantization instead"
         )
     x = ensure_tensor(x)
     cache_kv = ensure_tensor(cache_kv)
@@ -181,13 +178,19 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
         if use_pallas:
             from ....ops.pallas.decode_attention import decode_attention
 
-            return decode_attention(q, kc, vc, lens.astype(jnp.int32))
-        from ..fused_transformer import _masked_decode_attn as _mda
+            out = decode_attention(q, kc, vc, lens.astype(jnp.int32))
+        else:
+            from ..fused_transformer import _masked_decode_attn as _mda
 
-        q4 = q if q.ndim == 4 else q[:, None]
-        out = _mda(q4, kc, vc, lens,
-                   bias=maybe_mask[0] if maybe_mask else None)
-        return out if q.ndim == 4 else out[:, 0]
+            q4 = q if q.ndim == 4 else q[:, None]
+            out = _mda(q4, kc, vc, lens,
+                       bias=maybe_mask[0] if maybe_mask else None)
+            out = out if q.ndim == 4 else out[:, 0]
+        if out_scale and out_scale > 0:
+            out = jnp.clip(
+                jnp.round(out.astype(jnp.float32) / float(out_scale)),
+                -128, 127).astype(jnp.int8)
+        return out
 
     args = [x, cache_kv, sequence_lengths]
     if src_mask is not None:
@@ -230,17 +233,32 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     from ....ops.pallas.varlen_flash_attention import varlen_flash_attention
     from ....tensor._helpers import apply
 
-    if any(
-        kwargs.get(k) is not None
-        for k in ("qkv_out_scale", "cache_k_quant_scales",
-                  "cache_v_quant_scales", "out_shift", "out_smooth")
-    ):
-        # silently ignoring these would produce numerically wrong
-        # attention (the reference applies them inside the op)
-        raise NotImplementedError(
-            "block_multihead_attention: activation-quant fusion args are "
-            "not supported here — weight-only int8 serving quantizes the "
-            "projections (paddle.quantization), not this op's epilogue")
+    # Activation-quant / int8-KV-cache epilogues (round-5, reference
+    # fused_multi_transformer int8 variant — unverified, SURVEY.md §0).
+    # Conventions (paddle quant-op style, multipliers):
+    #   qkv_out_scale ((H+2HK)*D,): DEQUANT multiplier applied to the
+    #     incoming qkv (the int32/int8 projection output) BEFORE bias.
+    #   cache_k/v_quant_scales (HK,): QUANT multipliers — the pool holds
+    #     clip(round(k * qs), -128, 127) int8; cache_k/v_dequant_scales
+    #     default to 1/quant_scales and are applied inside the paged
+    #     kernel (prefill gathers dequantize the same way).
+    #   out_shift/out_smooth (H*D,): smooth-quant epilogue
+    #     (out + shift) * smooth applied to the attention output.
+    #   out_scale (scalar > 0): output quantized to int8 as
+    #     clip(round(out / out_scale), -128, 127).
+    qkv_out_scale = kwargs.get("qkv_out_scale")
+    cache_k_qs = kwargs.get("cache_k_quant_scales")
+    cache_v_qs = kwargs.get("cache_v_quant_scales")
+    cache_k_ds = kwargs.get("cache_k_dequant_scales")
+    cache_v_ds = kwargs.get("cache_v_dequant_scales")
+    out_shift = kwargs.get("out_shift")
+    out_smooth = kwargs.get("out_smooth")
+    out_scale = kwargs.get("out_scale", -1)
+    quant_cache = cache_k_qs is not None or cache_v_qs is not None
+    if quant_cache and (cache_k_qs is None or cache_v_qs is None):
+        raise ValueError(
+            "int8 KV cache needs BOTH cache_k_quant_scales and "
+            "cache_v_quant_scales")
     # rope/bias fusion (reference contract: applied INSIDE the op, to
     # this call's new q/k tokens at their absolute cache positions):
     #   rotary_embs: (2, max_seq_len, head_dim//2) — [0]=cos, [1]=sin
@@ -250,6 +268,19 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     qkv = ensure_tensor(qkv)
     key_cache = ensure_tensor(key_cache)
     value_cache = ensure_tensor(value_cache)
+    kc_dt = str(key_cache._value.dtype)
+    vc_dt = str(value_cache._value.dtype)
+    if kc_dt != vc_dt:
+        raise ValueError(
+            f"key_cache ({kc_dt}) and value_cache ({vc_dt}) dtypes "
+            f"must match")
+    if quant_cache and kc_dt != "int8":
+        raise ValueError(
+            f"cache_k/v_quant_scales given but the cache pools are "
+            f"{kc_dt}, not int8")
+    if not quant_cache and kc_dt == "int8":
+        raise ValueError(
+            "int8 cache pools need cache_k/v_quant_scales")
     if num_heads is None or kv_num_heads is None:
         raise ValueError(
             "block_multihead_attention requires num_heads/kv_num_heads "
@@ -329,10 +360,30 @@ def block_multihead_attention(qkv, key_cache, value_cache,
 
     abs_pos_j = jnp.asarray(abs_pos)
 
+    def _f32_vec(t, n):
+        return (None if t is None
+                else jnp.asarray(ensure_tensor(t)._value,
+                                 jnp.float32).reshape(n))
+
+    qkv_scale_v = _f32_vec(qkv_out_scale, (h + 2 * hk) * d)
+    k_qs_v = _f32_vec(cache_k_qs, hk)
+    v_qs_v = _f32_vec(cache_v_qs, hk)
+    k_ds_v = _f32_vec(cache_k_ds, hk) if cache_k_ds is not None else (
+        None if k_qs_v is None else 1.0 / k_qs_v)
+    v_ds_v = _f32_vec(cache_v_ds, hk) if cache_v_ds is not None else (
+        None if v_qs_v is None else 1.0 / v_qs_v)
+    out_shift_v = _f32_vec(out_shift, h * d)
+    out_smooth_v = _f32_vec(out_smooth, h * d)
+    out_scale_f = float(out_scale) if out_scale is not None else -1.0
+
     def fn(qkv_v, kp, vp, *fused):
         fused = list(fused)
         rot = fused.pop(0) if rotary_embs is not None else None
         bias = fused.pop(0) if qkv_bias is not None else None
+        if qkv_scale_v is not None:
+            # dequantize the projection output (reference: the int8 gemm
+            # emits int32; scale BEFORE the bias add)
+            qkv_v = qkv_v.astype(jnp.float32) * qkv_scale_v[None, :]
         if bias is not None:
             qkv_v = qkv_v + bias.astype(qkv_v.dtype)[None, :]
         q, k_new, v_new = split_qkv(qkv_v)
@@ -345,15 +396,32 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                                  position_ids=abs_pos_j[None])[0]
             k_new = apply_rotary_emb(k_new[None], cos, sin, neox=neox,
                                      position_ids=abs_pos_j[None])[0]
-        kp2 = kp.at[blk_ids, offs].set(k_new.astype(kp.dtype))
-        vp2 = vp.at[blk_ids, offs].set(v_new.astype(vp.dtype))
+        if quant_cache:
+            k_store = jnp.clip(
+                jnp.round(k_new.astype(jnp.float32)
+                          * k_qs_v[None, :, None]), -128, 127
+            ).astype(jnp.int8)
+            v_store = jnp.clip(
+                jnp.round(v_new.astype(jnp.float32)
+                          * v_qs_v[None, :, None]), -128, 127
+            ).astype(jnp.int8)
+        else:
+            k_store = k_new.astype(kp.dtype)
+            v_store = v_new.astype(vp.dtype)
+        kp2 = kp.at[blk_ids, offs].set(k_store)
+        vp2 = vp.at[blk_ids, offs].set(v_store)
         out = jnp.zeros((total, h, d), q.dtype)
         if len(pre_rows):
             q_pre = q[jnp.asarray(pre_tok)]
             # gather each prefill row's full context (cache + new) from
             # the updated pool
-            k_ctx = kp2[ctx_blk, ctx_off].astype(q.dtype)
-            v_ctx = vp2[ctx_blk, ctx_off].astype(q.dtype)
+            k_ctx = kp2[ctx_blk, ctx_off]
+            v_ctx = vp2[ctx_blk, ctx_off]
+            if quant_cache:
+                k_ctx = k_ctx.astype(jnp.float32) * k_ds_v[None, :, None]
+                v_ctx = v_ctx.astype(jnp.float32) * v_ds_v[None, :, None]
+            k_ctx = k_ctx.astype(q.dtype)
+            v_ctx = v_ctx.astype(q.dtype)
             o_pre = varlen_flash_attention(
                 q_pre, k_ctx, v_ctx, jnp.asarray(cu_q_pre),
                 jnp.asarray(cu_k_pre), causal=True)
@@ -361,9 +429,20 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         if len(dec_rows):
             o_dec = paged_decode_attention(
                 q[jnp.asarray(dec_tok)], kp2, vp2, dec_tbl,
-                dec_positions + 1)
+                dec_positions + 1,
+                k_scale=k_ds_v if quant_cache else None,
+                v_scale=v_ds_v if quant_cache else None)
             out = out.at[jnp.asarray(dec_tok)].set(o_dec)
-        return out.reshape(total, h * d), kp2, vp2
+        out_flat = out.reshape(total, h * d)
+        if out_shift_v is not None:
+            out_flat = out_flat + out_shift_v[None, :].astype(out_flat.dtype)
+        if out_smooth_v is not None:
+            out_flat = out_flat * out_smooth_v[None, :].astype(out_flat.dtype)
+        if out_scale_f > 0:
+            out_flat = jnp.clip(
+                jnp.round(out_flat.astype(jnp.float32) / out_scale_f),
+                -128, 127).astype(jnp.int8)
+        return out_flat, kp2, vp2
 
     fused_args = []
     if rotary_embs is not None:

@@ -204,11 +204,18 @@ def _norm(x, scale, bias, kind, eps):
     return out.astype(x.dtype)
 
 
-def _fused_stack(src, kc, vc, lens, wt, cfg: FusedMultiTransformer, offset):
-    """The scan over layers. src (B,S,E); kc/vc (L,B,Smax,HK,D) or None."""
+def _fused_stack(src, kc, vc, lens, wt, cfg: FusedMultiTransformer, offset,
+                 decode=None):
+    """The scan over layers. src (B,S,E); kc/vc (L,B,Smax,HK,D) or None.
+    ``offset`` may be a traced int32 when ``decode`` is passed explicitly
+    (the branch choice must be static; everything else — rope positions,
+    cache update slice, default lens — traces fine)."""
     b, s, e = src.shape
     H, HK, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    decode = kc is not None and s == 1 and offset > 0
+    if decode is None:
+        decode = kc is not None and s == 1 and offset > 0
+    else:
+        decode = bool(decode) and kc is not None and s == 1
 
     cos, sin = build_rope_cache(s, D, base=cfg.rope_theta,
                                 position_offset=offset)

@@ -28,8 +28,10 @@ def build_rope_cache(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
     inv_freq = 1.0 / (
         base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
-    pos = jnp.arange(position_offset, position_offset + seq_len,
-                     dtype=jnp.float32)
+    # offset + arange (not arange(offset, ...)): position_offset may be a
+    # traced scalar (chained-decode loops); seq_len is always static
+    pos = (jnp.asarray(position_offset, jnp.float32)
+           + jnp.arange(seq_len, dtype=jnp.float32))
     freqs = jnp.outer(pos, inv_freq)  # (S, D/2)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 

@@ -31,10 +31,11 @@ from ._utils import interpret_mode as _interpret_mode
 NEG_INF = -1e30
 
 
-def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, sm_scale, block_size, steps,
-                  group):
+def _paged_kernel(tables_ref, lens_ref, kscale_ref, vscale_ref, q_ref,
+                  k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, sm_scale,
+                  block_size, steps, group, has_scales):
     b = pl.program_id(0)
+    h = pl.program_id(1)
     ki = pl.program_id(2)
     length = lens_ref[b]
 
@@ -49,6 +50,12 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)   # (G, D)
         k = k_ref[0, 0].astype(jnp.float32)   # (BS, D)
         v = v_ref[0, 0].astype(jnp.float32)
+        if has_scales:
+            # int8 KV pools dequantize HERE, in VMEM — the cache stays
+            # int8 in HBM (half the residency of a bf16 pool); static
+            # flag so float pools keep the multiply-free hot loop
+            k = k * kscale_ref[h]
+            v = v * vscale_ref[h]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -76,19 +83,24 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                           sm_scale=None):
+                           sm_scale=None, k_scale=None, v_scale=None):
     """One-step decode attention over a paged KV pool.
 
     Args:
         q: (B, H, D) or (B, 1, H, D) — the new token's query heads.
         k_pool, v_pool: (num_blocks, block_size, HK, D) — the shared
-            block pool (paddle's cache layout, block-major).
+            block pool (paddle's cache layout, block-major). May be int8
+            when per-head dequant scales are supplied.
         block_tables: (B, max_blocks) int32 — pool block ids per
             sequence, in order; entries past the sequence's length are
             ignored (any value).
         seq_lens: (B,) int32 — valid tokens per sequence (including the
             one being decoded).
-    Returns (B, H, D) (or (B, 1, H, D) matching q's rank).
+        k_scale, v_scale: optional (HK,) f32 per-kv-head DEQUANT scales
+            for int8 pools — applied inside the kernel so the int8 bytes
+            are what rides HBM.
+    Returns (B, H, D) (or (B, 1, H, D) matching q's rank), in the
+    QUERY's dtype.
     """
     squeeze = False
     if q.ndim == 4:
@@ -111,8 +123,12 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
 
     lens = seq_lens.astype(jnp.int32)
     tables = block_tables.astype(jnp.int32)
+    ks = (jnp.ones((hk,), jnp.float32) if k_scale is None
+          else jnp.asarray(k_scale, jnp.float32).reshape(hk))
+    vs = (jnp.ones((hk,), jnp.float32) if v_scale is None
+          else jnp.asarray(v_scale, jnp.float32).reshape(hk))
 
-    def pool_idx(b_, h_, ki, tables_ref, lens_ref):
+    def pool_idx(b_, h_, ki, tables_ref, lens_ref, ks_ref, vs_ref):
         # dead step (past this sequence's blocks) → re-point at block 0;
         # the repeated DMA is elided and the body is predicated off
         live = ki * block_size < lens_ref[b_]
@@ -120,16 +136,17 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
         return (h_, blk, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4,
         grid=(b, hk, steps),
         in_specs=[
             pl.BlockSpec((1, 1, group, d),
-                         lambda b_, h_, ki, t, ln: (b_, h_, 0, 0)),
+                         lambda b_, h_, ki, t, ln, ks_, vs_: (b_, h_, 0, 0)),
             pl.BlockSpec((1, 1, block_size, d), pool_idx),
             pl.BlockSpec((1, 1, block_size, d), pool_idx),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, group, d), lambda b_, h_, ki, t, ln: (b_, h_, 0, 0)
+            (1, 1, group, d),
+            lambda b_, h_, ki, t, ln, ks_, vs_: (b_, h_, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((group, 1), jnp.float32),
@@ -141,11 +158,12 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
         functools.partial(
             _paged_kernel, sm_scale=sm_scale, block_size=block_size,
             steps=steps, group=group,
+            has_scales=k_scale is not None or v_scale is not None,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hk, group, d), q.dtype),
         interpret=_interpret_mode(),
-    )(tables, lens, qg, kp, vp)
+    )(tables, lens, ks, vs, qg, kp, vp)
     out = out.reshape(b, h, d)
     return out[:, None] if squeeze else out
 

@@ -675,6 +675,168 @@ def llama_7b_shape_b2_train():
         tokens_per_sec_per_chip=round(res["tokens_per_sec_per_chip"]))
 
 
+def llama_7b_shape_serving():
+    """Serving at the HEADLINE shape (round-5 verdict #4): the L=4
+    h4096/d128 GQA-32/8 stack through FusedMultiTransformer decode
+    (bf16 and weight-only int8) plus the paged-attention decode step
+    with bf16 vs int8 KV pools (round-5 in-kernel dequant). Decode
+    steps are chained data-dependently inside one jit (axon timing
+    methodology) — ms/token is the marginal chained-step cost."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.incubate.nn.fused_transformer import _fused_stack
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        E, H, HK, FFN, L = 4096, 32, 8, 11008, 4
+        B, prompt, new_probe = 4, 128, 16
+        dt = "bfloat16"
+    else:
+        E, H, HK, FFN, L = 64, 4, 2, 128, 2
+        B, prompt, new_probe = 2, 8, 2
+        dt = "float32"
+    D = E // H
+    smax = prompt + 140
+
+    paddle.seed(0)
+    fmt = FusedMultiTransformer(
+        E, H, FFN, activation="swiglu", norm_type="rmsnorm",
+        num_layers=L, num_key_value_heads=HK,
+        use_neox_rotary_style=False)
+    fmt.astype(dt)
+    rng = np.random.RandomState(0)
+
+    def fmt_decode_ms():
+        kc, vc = fmt.gen_cache(B, smax, dtype=dt)
+        src = paddle.to_tensor(
+            rng.randn(B, prompt, E).astype("f4") * 0.02).astype(dt)
+        _, (kc2, vc2) = fmt(src, caches=(kc, vc), time_step=0)
+        weights = [
+            fmt.ln_scale, fmt.ln_bias, fmt.qkv_weight, fmt.qkv_bias,
+            fmt.linear_weight, fmt.linear_bias, fmt.ffn_ln_scale,
+            fmt.ffn_ln_bias, fmt.ffn1_weight, fmt.ffn1_bias,
+            fmt.ffn2_weight, fmt.ffn2_bias, fmt.qkv_weight_scale,
+            fmt.linear_weight_scale, fmt.ffn1_weight_scale,
+            fmt.ffn2_weight_scale,
+        ]
+        w_idx = [i for i, w in enumerate(weights) if w is not None]
+        w_vals = [weights[i]._value for i in w_idx]
+
+        def chain(wv, src_v, kc_v, vc_v, n):
+            # n TRACED (one compile; distinct n → distinct dispatches,
+            # dodging both recompiles and the axon dispatch cache)
+            wt = {i: v for i, v in zip(w_idx, wv)}
+
+            def body(j, carry):
+                s_v, k_v, v_v = carry
+                return _fused_stack(s_v, k_v, v_v, None, wt, fmt,
+                                    prompt + j, decode=True)
+
+            return jax.lax.fori_loop(
+                0, n, body, (src_v, kc_v, vc_v))[0]
+
+        jc = jax.jit(chain)
+        tok = paddle.to_tensor(
+            rng.randn(B, 1, E).astype("f4") * 0.02).astype(dt)._value
+        args = (w_vals, tok, kc2._value, vc2._value)
+        float(jnp.sum(jc(*args, 2).astype(jnp.float32)))  # compile+warm
+        pers = []
+        for r in range(3):
+            n = new_probe + r
+            ts = {}
+            for m in (n, 2 * n):
+                t0 = time.perf_counter()
+                out = jc(*args, m)
+                float(jnp.sum(out.astype(jnp.float32)))
+                ts[m] = time.perf_counter() - t0
+            pers.append((ts[2 * n] - ts[n]) / n)
+        return float(np.median(pers)) * 1000  # median rides out tunnel noise
+
+    ms_bf16 = fmt_decode_ms()
+    fmt.quantize_weight_only()
+    ms_int8 = fmt_decode_ms()
+
+    # paged decode step, bf16 vs int8 KV pools (ragged serving contexts)
+    from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    bs = 256 if on_tpu else 32
+    nb = 136 if on_tpu else 16
+    pb = 8 if on_tpu else 2
+    lens = (rng.randint(100, 4000, pb) if on_tpu
+            else rng.randint(4, 20, pb)).astype(np.int32)
+    steps = int(np.ceil((lens.max() + 1) / bs))
+    tables = np.full((pb, steps), 0, np.int32)
+    nxt = 0
+    for i, ln in enumerate(lens):
+        for bi in range(int(np.ceil(ln / bs))):
+            tables[i, bi] = nxt % nb
+            nxt += 1
+    kp = (rng.randn(nb, bs, HK, D) * 0.3).astype("f4")
+    vp = (rng.randn(nb, bs, HK, D) * 0.3).astype("f4")
+    ks = (np.abs(kp).max(axis=(0, 1, 3)) / 127.0).astype("f4")
+    vs = (np.abs(vp).max(axis=(0, 1, 3)) / 127.0).astype("f4")
+    kp8 = np.clip(np.round(kp / ks[None, None, :, None]),
+                  -128, 127).astype(np.int8)
+    vp8 = np.clip(np.round(vp / vs[None, None, :, None]),
+                  -128, 127).astype(np.int8)
+    cdt = jnp.bfloat16 if on_tpu else jnp.float32
+
+    def paged_us(int8):
+        kpj = jnp.asarray(kp8 if int8 else kp.astype(cdt))
+        vpj = jnp.asarray(vp8 if int8 else vp.astype(cdt))
+        tb = jnp.asarray(tables)
+        ln = jnp.asarray(lens)
+        q0 = jnp.asarray((rng.randn(pb, H, D) * 0.3).astype("f4")).astype(cdt)
+
+        def chain(q, n):
+            def body(i, qq):
+                o = paged_decode_attention(
+                    qq, kpj, vpj, tb, ln,
+                    k_scale=jnp.asarray(ks) if int8 else None,
+                    v_scale=jnp.asarray(vs) if int8 else None)
+                return (qq + o * jnp.bfloat16(1e-3)).astype(qq.dtype) \
+                    if on_tpu else qq + o * 1e-3
+            return jax.lax.fori_loop(0, n, body, q)
+
+        jc = jax.jit(chain)  # n traced: one compile
+        float(jnp.sum(jc(q0, 2).astype(jnp.float32)))
+        pers = []
+        for r in range(3):
+            # long chains: the per-step cost is ~1 ms and tunnel noise is
+            # of the same order, so the N-vs-2N window must be >> noise
+            n = (64 if on_tpu else 8) + r
+            ts = {}
+            for m in (n, 2 * n):
+                t0 = time.perf_counter()
+                float(jnp.sum(jc(q0, m).astype(jnp.float32)))
+                ts[m] = time.perf_counter() - t0
+            pers.append((ts[2 * n] - ts[n]) / n)
+        return float(np.median(pers)) * 1e6
+
+    us_pool = paged_us(False)
+    us_pool8 = paged_us(True)
+    live_blocks = int(sum(int(np.ceil(ln / bs)) for ln in lens))
+    blk_bytes = bs * HK * D
+    kv_bytes_bf16 = live_blocks * blk_bytes * 2 * 2  # k+v, 2B
+    kv_bytes_int8 = live_blocks * blk_bytes * 2      # k+v, 1B
+    cache_bytes_fmt = L * B * smax * HK * D * 2 * 2
+
+    return {
+        "metric": "llama_7b_shape_serving_decode",
+        "value": round(B / (ms_bf16 / 1000)), "unit": "tok/s",
+        "ms_per_token_bf16": round(ms_bf16, 2),
+        "ms_per_token_int8": round(ms_int8, 2),
+        "int8_speedup": round(ms_bf16 / ms_int8, 2),
+        "batch": B, "fmt_cache_bytes": cache_bytes_fmt,
+        "paged_step_us_bf16": round(us_pool),
+        "paged_step_us_int8kv": round(us_pool8),
+        "paged_kv_bytes_bf16": kv_bytes_bf16,
+        "paged_kv_bytes_int8": kv_bytes_int8,
+    }
+
+
 CONFIGS = {
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
@@ -687,6 +849,7 @@ CONFIGS = {
     "llama_941m_packed_train": llama_941m_packed_train,
     "llama_7b_shape_train": llama_7b_shape_train,
     "llama_7b_shape_b2_train": llama_7b_shape_b2_train,
+    "llama_7b_shape_serving": llama_7b_shape_serving,
     "llama_7b_shape_longctx": llama_7b_shape_longctx,
     "moe_dispatch": moe_dispatch,
 }

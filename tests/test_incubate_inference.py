@@ -217,9 +217,12 @@ def test_masked_multihead_attention_src_mask_and_validation():
 
     with pytest.raises(ValueError, match="requires"):
         masked_multihead_attention(q)
-    with pytest.raises(NotImplementedError, match="out_scale"):
-        masked_multihead_attention(
-            q, ckv, sequence_lengths=lens, out_scale=0.5)
+    # round-5: out_scale is a supported a8w8 epilogue — int8 out,
+    # clip(round(out / out_scale)) (full parity test lives in
+    # test_paged_attention.test_masked_mha_out_scale_quant)
+    out_q8 = masked_multihead_attention(
+        q, ckv, sequence_lengths=lens, out_scale=0.5)
+    assert str(out_q8._value.dtype) == "int8"
 
 
 def test_predictor_exact_inputs_and_clone_isolation(tmp_path):

@@ -315,23 +315,205 @@ def test_block_mha_inactive_rows_skipped():
     np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
-def test_block_mha_rejects_unsupported_fusions():
+def test_block_mha_quant_arg_validation():
+    """Round-5: the quant fusion args are accepted, but inconsistent
+    combinations must refuse loudly — int8 pools without scales, scales
+    with float pools, or only one of the k/v scale pair."""
     from paddle_tpu.incubate.nn.functional import block_multihead_attention
 
-    # rope/bias are fused since round 4; ACTIVATION-quant epilogue args
-    # must still refuse loudly (silent ignore = wrong numerics)
-    with pytest.raises(NotImplementedError, match="quant"):
-        block_multihead_attention(
+    def call(kc_dtype="f4", **kw):
+        return block_multihead_attention(
             paddle.to_tensor(np.zeros((1, 8 * 64), "f4")),
-            paddle.to_tensor(np.zeros((2, 32, 2, 64), "f4")),
-            paddle.to_tensor(np.zeros((2, 32, 2, 64), "f4")),
+            paddle.to_tensor(np.zeros((2, 32, 2, 64), kc_dtype)),
+            paddle.to_tensor(np.zeros((2, 32, 2, 64), kc_dtype)),
             seq_lens_encoder=paddle.to_tensor(np.zeros(1, "i4")),
             seq_lens_decoder=paddle.to_tensor(np.zeros(1, "i4")),
             seq_lens_this_time=paddle.to_tensor(np.ones(1, "i4")),
             block_tables=paddle.to_tensor(np.zeros((1, 1), "i4")),
-            num_heads=4, kv_num_heads=2,
-            qkv_out_scale=paddle.to_tensor(np.ones(4, "f4")),
-        )
+            num_heads=4, kv_num_heads=2, **kw)
+
+    ones2 = paddle.to_tensor(np.ones(2, "f4"))
+    with pytest.raises(ValueError, match="BOTH"):
+        call(cache_k_quant_scales=ones2)
+    with pytest.raises(ValueError, match="int8"):
+        call(kc_dtype="i1")  # int8 pools, no scales
+    with pytest.raises(ValueError, match="not int8"):
+        call(cache_k_quant_scales=ones2, cache_v_quant_scales=ones2)
+
+
+def _quant_setup(rng, lens, h=4, hk=2, d=64, bs=32):
+    """qkv whose k/v lanes sit exactly on the int8 grid for scale 2.0 —
+    quantization is lossless, so int8-cache output must EQUAL float."""
+    b, total = len(lens), sum(lens)
+    qkv = rng.randn(total, (h + 2 * hk) * d).astype("f4")
+    # k/v sections: multiples of 0.5 in [-60, 60] → exact at qs=2.0
+    kv = rng.randint(-120, 121, (total, 2 * hk * d)).astype("f4") / 2.0
+    qkv[:, h * d:] = kv
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    return qkv, cu
+
+
+def test_block_mha_int8_kv_cache_matches_float():
+    """Prefill + decode with int8 pools and per-head quant scales must
+    match the float-pool path exactly when values sit on the quant grid
+    (proves the wiring: quantize-on-write, dequant-in-kernel/gather)."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+
+    rng = np.random.RandomState(6)
+    h, hk, d, bs = 4, 2, 64, 32
+    lens = [9, 21]
+    b = len(lens)
+    qkv_np, cu = _quant_setup(rng, lens, h, hk, d, bs)
+    qs = paddle.to_tensor(np.full(hk, 2.0, "f4"))
+
+    def run(int8):
+        pool = PagedKVCachePool(num_blocks=16, block_size=bs,
+                                num_kv_heads=hk, head_dim=d,
+                                dtype=jnp.int8 if int8 else jnp.float32)
+        for i, ln in enumerate(lens):
+            pool.ensure(i, ln)
+        kc = paddle.to_tensor(np.zeros((16, bs, hk, d),
+                                       "i1" if int8 else "f4"))
+        vc = paddle.to_tensor(np.zeros((16, bs, hk, d),
+                                       "i1" if int8 else "f4"))
+        quant = dict(cache_k_quant_scales=qs, cache_v_quant_scales=qs) \
+            if int8 else {}
+        out = block_multihead_attention(
+            paddle.to_tensor(qkv_np), kc, vc,
+            seq_lens_encoder=paddle.to_tensor(np.asarray(lens, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.zeros(b, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.asarray(lens, "i4")),
+            block_tables=paddle.to_tensor(
+                np.asarray(pool.block_table_array(range(b)))),
+            num_heads=h, kv_num_heads=hk, **quant)
+        # decode one token per sequence from the (int8) cache
+        for i in range(b):
+            pool.ensure(i, lens[i] + 1)
+        qkv_dec, _ = _quant_setup(rng2, [1] * b, h, hk, d, bs)
+        out_dec = block_multihead_attention(
+            paddle.to_tensor(qkv_dec), kc, vc,
+            seq_lens_encoder=paddle.to_tensor(np.zeros(b, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.asarray(lens, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.ones(b, "i4")),
+            block_tables=paddle.to_tensor(
+                np.asarray(pool.block_table_array(range(b)))),
+            num_heads=h, kv_num_heads=hk, **quant)
+        return (out.numpy(), out_dec.numpy(),
+                np.asarray(kc._value), np.asarray(vc._value))
+
+    rng2 = np.random.RandomState(7)
+    o_i8, od_i8, kc_i8, _ = run(True)
+    rng2 = np.random.RandomState(7)
+    o_f, od_f, kc_f, _ = run(False)
+    assert kc_i8.dtype == np.int8  # the pool genuinely holds int8
+    np.testing.assert_allclose(o_i8, o_f, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(od_i8, od_f, rtol=2e-5, atol=2e-5)
+    # the int8 cache dequantizes to exactly the float cache
+    np.testing.assert_allclose(kc_i8.astype("f4") / 2.0, kc_f,
+                               rtol=0, atol=0)
+
+
+def test_block_mha_qkv_out_scale_dequant():
+    """qkv_out_scale applied inside == pre-scaling the qkv outside."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+
+    rng = np.random.RandomState(8)
+    h, hk, d, bs = 4, 2, 64, 32
+    lens = [7, 12]
+    b, total = len(lens), sum(lens)
+    nchan = (h + 2 * hk) * d
+    qkv_int = rng.randint(-1000, 1000, (total, nchan)).astype("f4")
+    scale = (0.001 * (1 + np.arange(nchan) % 5)).astype("f4")
+
+    def run(fused):
+        pool = PagedKVCachePool(num_blocks=16, block_size=bs,
+                                num_kv_heads=hk, head_dim=d,
+                                dtype=jnp.float32)
+        for i, ln in enumerate(lens):
+            pool.ensure(i, ln)
+        kc = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+        vc = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+        qkv_in = qkv_int if fused else qkv_int * scale[None, :]
+        kw = dict(qkv_out_scale=paddle.to_tensor(scale)) if fused else {}
+        out = block_multihead_attention(
+            paddle.to_tensor(qkv_in), kc, vc,
+            seq_lens_encoder=paddle.to_tensor(np.asarray(lens, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.zeros(b, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.asarray(lens, "i4")),
+            block_tables=paddle.to_tensor(
+                np.asarray(pool.block_table_array(range(b)))),
+            num_heads=h, kv_num_heads=hk, **kw)
+        return out.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=2e-5)
+
+
+def test_block_mha_out_quant_epilogue():
+    """out_shift + out_smooth + out_scale: int8 output must equal the
+    quantize-outside-the-op reference applied to the float output."""
+    from paddle_tpu.incubate.nn.functional import block_multihead_attention
+
+    rng = np.random.RandomState(9)
+    h, hk, d, bs = 4, 2, 64, 32
+    lens = [9, 14]
+    b, total = len(lens), sum(lens)
+    qkv_np = rng.randn(total, (h + 2 * hk) * d).astype("f4")
+    shift = (rng.randn(h * d) * 0.1).astype("f4")
+    smooth = (1.0 + rng.rand(h * d)).astype("f4")
+    out_scale = 0.02
+
+    def run(**kw):
+        pool = PagedKVCachePool(num_blocks=16, block_size=bs,
+                                num_kv_heads=hk, head_dim=d,
+                                dtype=jnp.float32)
+        for i, ln in enumerate(lens):
+            pool.ensure(i, ln)
+        kc = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+        vc = paddle.to_tensor(np.zeros((16, bs, hk, d), "f4"))
+        return block_multihead_attention(
+            paddle.to_tensor(qkv_np), kc, vc,
+            seq_lens_encoder=paddle.to_tensor(np.asarray(lens, "i4")),
+            seq_lens_decoder=paddle.to_tensor(np.zeros(b, "i4")),
+            seq_lens_this_time=paddle.to_tensor(np.asarray(lens, "i4")),
+            block_tables=paddle.to_tensor(
+                np.asarray(pool.block_table_array(range(b)))),
+            num_heads=h, kv_num_heads=hk, **kw).numpy()
+
+    plain = run()
+    fused = run(out_shift=paddle.to_tensor(shift),
+                out_smooth=paddle.to_tensor(smooth), out_scale=out_scale)
+    assert fused.dtype == np.int8
+    expect = np.clip(
+        np.round((plain + shift[None]) * smooth[None] / out_scale),
+        -128, 127).astype(np.int8)
+    # rounding at the .5 boundary may differ by 1 lsb between XLA and
+    # numpy round-half-to-even on float noise; require exact match on
+    # 99.9% and |diff| <= 1 everywhere
+    diff = np.abs(fused.astype(np.int32) - expect.astype(np.int32))
+    assert diff.max() <= 1
+    assert (diff == 0).mean() > 0.999
+
+
+def test_masked_mha_out_scale_quant():
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+
+    rng = np.random.RandomState(10)
+    b, h, hk, d, smax = 2, 4, 2, 64, 32
+    lens = np.asarray([9, 17], "i4")
+    cache = rng.randn(2, b, smax, hk, d).astype("f4")
+    x = rng.randn(b, h, d).astype("f4")
+    plain = masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens)).numpy()
+    scale = 0.015
+    q8 = masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens), out_scale=scale).numpy()
+    assert q8.dtype == np.int8
+    expect = np.clip(np.round(plain / scale), -128, 127).astype(np.int8)
+    diff = np.abs(q8.astype(np.int32) - expect.astype(np.int32))
+    assert diff.max() <= 1
+    assert (diff == 0).mean() > 0.999
 
 
 def test_block_multihead_attention_fused_rope_bias_parity():
