@@ -48,6 +48,10 @@ def new_group(ranks=None, backend=None, timeout=None, mesh_axis=None):
     gid = _GROUP_COUNTER[0]
     if ranks is None:
         ranks = list(range(get_world_size()))
+    # sorted (torch new_group semantics): group rank = position among
+    # SORTED global ranks, which is also the row order subgroup
+    # all_gather fills — tensor_list[group.rank] is always "my" row
+    ranks = sorted(int(r) for r in ranks)
     me = get_rank()
     grp = Group(
         ranks.index(me) if me in ranks else -1, ranks, gid, mesh_axis

@@ -207,6 +207,29 @@ def test_two_process_rendezvous_and_collective(tmp_path):
         "else:\n"
         "    dist.recv(pt, src=0)\n"
         "    print('RECV', rank, float(np.asarray(pt._value)[0]))\n"
+        # round-5 subgroup semantics: a singleton group on rank1 — the
+        # member reduces over the sub-mesh (sum over itself), the
+        # non-member's tensor/list stay untouched
+        "sg = dist.new_group(ranks=[1])\n"
+        "sx = paddle.to_tensor(np.asarray([float(5 * (rank + 1))], 'f4'))\n"
+        "dist.all_reduce(sx, group=sg)\n"
+        "print('SUBAR', rank, float(np.asarray(sx._value)[0]))\n"
+        "sl2 = []\n"
+        "sgt = paddle.to_tensor(np.asarray([float(rank + 30)], 'f4'))\n"
+        "dist.all_gather(sl2, sgt, group=sg)\n"
+        "print('SUBAG', rank, [float(np.asarray(t._value)[0]) for t in sl2])\n"
+        # src outside the group must refuse on every caller
+        "try:\n"
+        "    dist.broadcast(sx, src=0, group=sg)\n"
+        "    print('SUBBC', rank, 'noraise')\n"
+        "except ValueError:\n"
+        "    print('SUBBC', rank, 'raised')\n"
+        # collectives without a sub-mesh implementation refuse loudly
+        "try:\n"
+        "    dist.scatter(sx, None, src=1, group=sg)\n"
+        "    print('SUBSC', rank, 'noraise')\n"
+        "except NotImplementedError:\n"
+        "    print('SUBSC', rank, 'raised')\n"
     )
     try:
         r = _launch(tmp_path, body,
@@ -242,6 +265,75 @@ def test_two_process_rendezvous_and_collective(tmp_path):
     assert "SOBJ 0 r0gets" in out and "SOBJ 1 r1gets" in out
     # p2p: rank1 received rank0's 41.0 (its own value was 42.0)
     assert "SENT 0" in out and "RECV 1 41.0" in out
+    # subgroup: member (rank1) reduced over the singleton sub-mesh
+    # (10.0 = its own value), non-member untouched (5.0)
+    assert "SUBAR 0 5.0" in out and "SUBAR 1 10.0" in out
+    assert "SUBAG 0 []" in out and "SUBAG 1 [31.0]" in out
+    assert "SUBBC 0 raised" in out and "SUBBC 1 raised" in out
+    assert "SUBSC 0 raised" in out and "SUBSC 1 raised" in out
+
+
+def test_three_process_two_member_subgroup(tmp_path):
+    """Round-5 subgroup semantics, the real case: a 2-member sub-mesh in
+    a 3-process job — the members' collective must coordinate ACROSS a
+    process boundary while the third process skips it entirely, and a
+    fleet-style mesh_axis group must keep world semantics (its ranks are
+    device positions, not process ids)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    body = (
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.init_parallel_env()\n"
+        "import jax\n"
+        "assert jax.process_count() == 3, jax.process_count()\n"
+        "rank = dist.get_rank()\n"
+        # unsorted on purpose: new_group sorts → members [0, 2]
+        "sg = dist.new_group(ranks=[2, 0])\n"
+        "assert sg.ranks == [0, 2], sg.ranks\n"
+        "x = paddle.to_tensor(np.asarray([float(rank + 1)], 'f4'))\n"
+        "dist.all_reduce(x, group=sg)\n"
+        "print('SG3AR', rank, float(np.asarray(x._value)[0]))\n"
+        "outs = []\n"
+        "g = paddle.to_tensor(np.asarray([float(100 + rank)], 'f4'))\n"
+        "dist.all_gather(outs, g, group=sg)\n"
+        "print('SG3AG', rank, [float(np.asarray(t._value)[0]) for t in outs])\n"
+        # broadcast from the higher member crosses the sub-mesh
+        "b = paddle.to_tensor(np.asarray([float((rank + 1) * 10)], 'f4'))\n"
+        "dist.broadcast(b, src=2, group=sg)\n"
+        "print('SG3BC', rank, float(np.asarray(b._value)[0]))\n"
+        # mesh_axis groups are chip-level handles: world semantics kept
+        "mg = dist.new_group(ranks=[0, 1], mesh_axis='mp')\n"
+        "w = paddle.to_tensor(np.asarray([1.0], 'f4'))\n"
+        "dist.all_reduce(w, group=mg)\n"
+        "print('SG3MA', rank, float(np.asarray(w._value)[0]))\n"
+    )
+    try:
+        r = _launch(tmp_path, body,
+                    ["--nproc_per_node", "3",
+                     "--master", f"127.0.0.1:{port}"])
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"3-process rendezvous not runnable here: {e}")
+    out = r.stdout.decode()
+    assert r.returncode == 0, (out, r.stderr.decode()[-2000:])
+    # members 0 and 2 reduce 1+3=4 across the process boundary; rank 1
+    # (non-member) keeps its 2.0
+    assert "SG3AR 0 4.0" in out and "SG3AR 2 4.0" in out
+    assert "SG3AR 1 2.0" in out
+    # gather rows in sorted-global-rank order; non-member list untouched
+    assert "SG3AG 0 [100.0, 102.0]" in out
+    assert "SG3AG 2 [100.0, 102.0]" in out
+    assert "SG3AG 1 []" in out
+    # broadcast from member 2: member 0 overwritten, rank 1 untouched
+    assert "SG3BC 0 30.0" in out and "SG3BC 2 30.0" in out
+    assert "SG3BC 1 20.0" in out
+    # mesh_axis group → world semantics: all 3 processes summed
+    assert "SG3MA 0 3.0" in out and "SG3MA 1 3.0" in out \
+        and "SG3MA 2 3.0" in out
 
 
 def test_two_process_rpc(tmp_path):
