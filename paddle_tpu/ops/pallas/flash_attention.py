@@ -48,6 +48,22 @@ def _default_blocks(head_dim):
 
 
 
+def _run_full(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
+    """(run, full) tile validity: ``run`` = the tile contributes at all
+    (not past the kv length / not entirely above the causal diagonal);
+    ``full`` = every (q, k) pair in the tile is valid, i.e. exactly the
+    condition under which _mask_for_block is all-true — interior tiles
+    skip the mask build. Shared by fwd/dq/dkv so the boundary math can
+    never desynchronize between forward and backward."""
+    run = ki * block_k < kv_len
+    full = (ki + 1) * block_k <= kv_len
+    if causal:
+        run = run & (ki * block_k <= (qi + 1) * block_q - 1 + causal_offset)
+        full = full & (
+            (ki + 1) * block_k - 1 <= qi * block_q + causal_offset)
+    return run, full
+
+
 def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
     """Boolean validity mask (BQ, BK) for one (q-block, kv-block) tile."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -77,37 +93,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # skip kv blocks that are entirely invalid (causal future or padding)
-    run = ki * block_k < kv_len
-    if causal:
-        run = run & (ki * block_k <= (qi + 1) * block_q - 1 + causal_offset)
+    # interior (fully-valid) tiles skip the mask build entirely — the
+    # iota/compare/where work on a (BQ, BK) tile is pure VPU cost and
+    # dominates diagonal-heavy causal grids (round-5 fix, mirroring the
+    # varlen kernel's run/full split)
+    run, full = _run_full(qi, ki, block_q, block_k, causal, causal_offset,
+                          kv_len)
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0].astype(jnp.float32)  # (BQ, D)
-        k = k_ref[0, 0].astype(jnp.float32)  # (BK, D)
-        v = v_ref[0, 0].astype(jnp.float32)  # (BK, D)
+    def _accumulate(masked):
+        # matmul INPUTS stay in the storage dtype (bf16 on TPU) with f32
+        # ACCUMULATION via preferred_element_type — an .astype(f32) on
+        # q/k/v before the dot forces quarter-rate f32 MXU passes
+        # (round-5 fix: this was the "attention at ~50% of the matmul
+        # tier" cost in the round-4 long-context rows)
+        q = q_ref[0, 0]  # (BQ, D)
+        k = k_ref[0, 0]  # (BK, D)
+        v = v_ref[0, 0]  # (BK, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale  # (BQ, BK)
-        mask = _mask_for_block(qi, ki, block_q, block_k, causal,
-                               causal_offset, kv_len)
-        s = jnp.where(mask, s, NEG_INF)
+        ) * sm_scale  # (BQ, BK) f32
+        if masked:
+            mask = _mask_for_block(qi, ki, block_q, block_k, causal,
+                                   causal_offset, kv_len)
+            s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]  # (BQ, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        # fully-masked rows keep m=NEG_INF; mask p explicitly so
-        # exp(NEG_INF - NEG_INF) = 1 cannot leak in
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if masked:
+            # fully-masked rows keep m=NEG_INF; mask p explicitly so
+            # exp(NEG_INF - NEG_INF) = 1 cannot leak in
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
         l_scr[:] = l_new
+
+    @pl.when(run & full)
+    def _interior():
+        _accumulate(False)
+
+    @pl.when(run & ~full)
+    def _boundary():
+        _accumulate(True)
 
     @pl.when(ki == kv_steps - 1)
     def _finalize():
@@ -171,31 +204,41 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = ki * block_k < kv_len
-    if causal:
-        run = run & (ki * block_k <= (qi + 1) * block_q - 1 + causal_offset)
+    run, full = _run_full(qi, ki, block_q, block_k, causal, causal_offset,
+                          kv_len)
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+    def _body(masked):
+        # storage-dtype matmul inputs + f32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]    # (BQ, 1)
         delta = delta_ref[0, 0]  # (BQ, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        mask = _mask_for_block(qi, ki, block_q, block_k, causal,
-                               causal_offset, kv_len)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        if masked:
+            mask = _mask_for_block(qi, ki, block_q, block_k, causal,
+                                   causal_offset, kv_len)
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
+
+    @pl.when(run & full)
+    def _interior():
+        _body(False)
+
+    @pl.when(run & ~full)
+    def _boundary():
+        _body(True)
 
     @pl.when(ki == kv_steps - 1)
     def _store():
@@ -216,35 +259,45 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = ki * block_k < kv_len
-    if causal:
-        # q block entirely before this kv block → no contribution
-        run = run & ((qi + 1) * block_q - 1 + causal_offset >= ki * block_k)
+    run, full = _run_full(qi, ki, block_q, block_k, causal, causal_offset,
+                          kv_len)
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+    def _body(masked):
+        # storage-dtype matmul inputs + f32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        mask = _mask_for_block(qi, ki, block_q, block_k, causal,
-                               causal_offset, kv_len)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (BQ, BK)
+        p = jnp.exp(s - lse)  # (BQ, BK) f32
+        if masked:
+            mask = _mask_for_block(qi, ki, block_q, block_k, causal,
+                                   causal_offset, kv_len)
+            p = jnp.where(mask, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * sm_scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
+
+    @pl.when(run & full)
+    def _interior():
+        _body(False)
+
+    @pl.when(run & ~full)
+    def _boundary():
+        _body(True)
 
     @pl.when(qi == q_steps - 1)
     def _store():

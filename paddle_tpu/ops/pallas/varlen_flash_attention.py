@@ -50,11 +50,14 @@ _REL_HI = 2 ** 30
 
 
 def _default_blocks(head_dim):
-    """One notch below the dense kernel's sizing: the segment/relative
-    aux tiles push the dkv backward past v5e's 16 MB scoped VMEM at
-    (1024, 1024), so 512 is the measured ceiling."""
+    """(1024, 1024) matches the dense kernel since round 5: keeping the
+    matmul operands in their storage dtype (bf16) freed the VMEM the old
+    f32 tile copies consumed, so the dkv backward now fits at 1024 with
+    the segment/relative aux tiles (measured: fwd 1.47x, fwd+bwd 1.22x
+    over the old 512 ceiling on the round-3 ragged-16k workload;
+    (2048, 1024) still exceeds v5e's 16 MB scoped VMEM)."""
     if head_dim <= 128:
-        return 512, 512
+        return 1024, 1024
     return 256, 256
 
 
@@ -98,15 +101,17 @@ def _fwd_kernel(run_ref, full_ref, q_ref, k_ref, v_ref,
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        # storage-dtype matmul inputs + f32 accumulation (round-5: an
+        # .astype(f32) on the operands forces quarter-rate f32 MXU)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
 
     def scores():
         return jax.lax.dot_general(
-            q_ref[0].astype(jnp.float32), k_ref[0].astype(jnp.float32),
+            q_ref[0], k_ref[0],
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
@@ -203,10 +208,11 @@ def _bwd_dq_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     full = full_ref[qi, ki] == 1
 
     def body(mask):
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # storage-dtype matmul inputs + f32 accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -218,7 +224,8 @@ def _bwd_dq_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         )
         ds = p * (dp - delta_ref[0]) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
 
     @pl.when(run & full)
@@ -253,10 +260,11 @@ def _bwd_dkv_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     full = full_ref[qi, ki] == 1
 
     def body(mask):
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # storage-dtype matmul inputs + f32 accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -264,14 +272,16 @@ def _bwd_dkv_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_ref[0]) * sm_scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
 
     @pl.when(run & full)

@@ -492,6 +492,9 @@ def llama_7b_shape_longctx():
         num_attention_heads=32 if on_tpu else 4,
         max_position_embeddings=seq, tensor_parallel=False,
         use_recompute=True, recompute_granularity="core_attn",
+        # round-5 recipe: fused lm-head+CE — at S16k the logits buffers
+        # are ~4 GB and the fused op's extra-matmul share is negligible
+        fuse_linear_cross_entropy=True, lce_chunk_rows=4096,
     )
     model, step, _ = _bench().build_step(
         cfg, 1, seq, moment_dtype="bfloat16" if on_tpu else "float32")
