@@ -21,7 +21,8 @@ class GPTConfig:
                  intermediate_size=None, max_position_embeddings=1024,
                  layer_norm_epsilon=1e-5, dropout=0.0,
                  tensor_parallel=False, use_recompute=False,
-                 recompute_granularity="full", dtype="float32"):
+                 recompute_granularity="full", dtype="float32",
+                 fuse_linear_cross_entropy=False, lce_chunk_rows=1024):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -34,6 +35,11 @@ class GPTConfig:
         self.use_recompute = use_recompute
         self.recompute_granularity = recompute_granularity
         self.dtype = dtype
+        # training-loss fusion (same contract as LlamaConfig): forward()
+        # returns the final hidden states and the caller applies the
+        # chunked fused lm-head+CE — full (N, V) logits never exist
+        self.fuse_linear_cross_entropy = fuse_linear_cross_entropy
+        self.lce_chunk_rows = lce_chunk_rows
 
     @property
     def head_dim(self):
@@ -165,4 +171,8 @@ class GPTForCausalLM(Layer):
                                   bias_attr=False)
 
     def forward(self, input_ids):
-        return self.lm_head(self.gpt(input_ids))
+        hidden = self.gpt(input_ids)
+        if self.config.fuse_linear_cross_entropy:
+            # lm_head is applied inside the fused criterion
+            return hidden
+        return self.lm_head(hidden)
