@@ -2,11 +2,15 @@
 class of workloads; reference: fused_multi_transformer decode HOT LOOP,
 SURVEY.md §3.5).
 
-Two modes:
-- ``generate``: host loop, one jitted step per token (debuggable).
-- ``generate_on_device``: the ENTIRE decode loop inside one XLA program
-  (``lax.while_loop`` over a jitted single-token step with static cache
-  shapes) — one dispatch per sequence, the idiomatic TPU serving shape.
+Entry points:
+- ``greedy_search``: host loop, one jitted step per token (debuggable,
+  supports eos early-exit).
+- ``generate_on_device`` / ``sampling_search`` / ``beam_search``: the
+  ENTIRE decode loop inside one XLA program (prefill + ``lax.scan`` of
+  single-token steps, static cache shapes) — one dispatch per sequence,
+  the idiomatic TPU serving shape; compiled programs cached per model.
+- ``generate``: the paddle-style facade routing decode_strategy to the
+  on-device loops above.
 """
 from __future__ import annotations
 
@@ -17,7 +21,8 @@ from ..core.tensor import Tensor
 from ..core import autograd
 from ..jit import functional_call
 
-__all__ = ["greedy_search", "generate_on_device"]
+__all__ = ["greedy_search", "generate_on_device", "sampling_search",
+           "beam_search", "generate"]
 
 
 def _logits_fn(model, p_vals, ids, offset_val, kc, vc):
@@ -201,3 +206,224 @@ def generate_on_device(model, input_ids, max_new_tokens=32):
     jit_cache[cache_key] = jitted
     tokens = jitted(p_vals, input_ids._value)
     return paddle.to_tensor(tokens)
+
+
+def _filter_logits(logits, top_k, top_p, temperature):
+    """Sampling logits transform (reference: the TopK/TopP process logic
+    in generation_utils — unverified, SURVEY.md §0): temperature scale,
+    then top-k cut, then nucleus (top-p) cut. Pure jax, (B, V) f32.
+    temperature=0 is near-greedy (clamped to 1e-6, an effective
+    argmax); top-k uses lax.top_k and top-p one descending sort — this
+    runs inside the scanned decode hot loop."""
+    logits = logits.astype(jnp.float32)
+    if temperature is not None and temperature != 1.0:
+        logits = logits / jnp.float32(max(float(temperature), 1e-6))
+    v = logits.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = jax.lax.top_k(logits, int(top_k))[0][:, -1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (the
+        # first token always survives)
+        keep_sorted = cum - probs < top_p
+        n_keep = jnp.sum(keep_sorted, axis=-1)  # (B,)
+        cutoff = jnp.take_along_axis(
+            sorted_l, jnp.maximum(n_keep - 1, 0)[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def _model_jit_cache(model, key, build):
+    """Per-model compiled-program cache (a fresh closure per call would
+    recompile the whole decode loop every time)."""
+    cache = getattr(model, "_generate_jit_cache", None)
+    if cache is None:
+        cache = model._generate_jit_cache = {}
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def sampling_search(model, input_ids, max_new_tokens=32, top_k=0,
+                    top_p=1.0, temperature=1.0, seed=0):
+    """Whole SAMPLING decode in one dispatch (reference:
+    generation_utils' decode_strategy="sampling" — unverified, SURVEY
+    §0): prefill + lax.scan of single-token steps, each drawing from
+    the temperature/top-k/top-p-filtered distribution with a per-step
+    fold_in of the seed. Deterministic given (seed, inputs)."""
+    import paddle_tpu as paddle
+
+    input_ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle.to_tensor(input_ids)
+    b, s_in = input_ids.shape
+    total = s_in + max_new_tokens
+    cfg = model.config
+    p_vals = [p._value for _, p in model.named_parameters()]
+    cache_dtype = p_vals[0].dtype
+
+    def full(pv, ids, key):
+        kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+                        cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
+        vc = jnp.zeros_like(kc)
+        logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)
+        filt = _filter_logits(logits[:, -1], top_k, top_p, temperature)
+        first = jax.random.categorical(
+            jax.random.fold_in(key, 0), filt).astype(jnp.int32)[:, None]
+
+        def body(carry, i):
+            pos, tok, kc, vc = carry
+            with autograd.no_grad():
+                def fwd(t_):
+                    return _manual_decode(model, t_, pos, kc, vc)
+
+                (lg, kc2, vc2), _ = functional_call(
+                    model, fwd, [Tensor(tok, stop_gradient=True)], {},
+                    pv, [])
+            filt = _filter_logits(lg[:, -1], top_k, top_p, temperature)
+            nxt = jax.random.categorical(
+                jax.random.fold_in(key, i + 1), filt
+            ).astype(jnp.int32)[:, None]
+            return (pos + 1, nxt, kc2, vc2), tok[:, 0]
+
+        (_, last, _, _), toks = jax.lax.scan(
+            body, (jnp.int32(s_in), first, kc, vc),
+            jnp.arange(max_new_tokens - 1))
+        gen = jnp.concatenate([toks.T, last], axis=1)
+        return jnp.concatenate([ids.astype(jnp.int32), gen], axis=1)
+
+    jitted = _model_jit_cache(
+        model,
+        ("sampling", b, s_in, max_new_tokens, str(cache_dtype),
+         int(top_k), float(top_p), float(temperature)),
+        lambda: jax.jit(full))
+    tokens = jitted(p_vals, input_ids._value, jax.random.PRNGKey(seed))
+    return paddle.to_tensor(tokens)
+
+
+def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
+                length_penalty=1.0):
+    """Whole BEAM-SEARCH decode in one dispatch (reference:
+    generation_utils' decode_strategy="beam_search" — unverified,
+    SURVEY §0): beams ride the batch dim (B*num_beams rows), the scan
+    step reorders the stacked KV caches with the surviving beams'
+    indices, and the best beam per batch row is returned. Fixed-length
+    variant: sequences run to max_new_tokens (no early eos
+    retirement) — NOTE all beams therefore share one length, so
+    ``length_penalty`` cannot change the argmax today; the parameter is
+    kept for the paddle API shape and becomes live once variable-length
+    (eos-retiring) decode exists."""
+    import paddle_tpu as paddle
+
+    input_ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle.to_tensor(input_ids)
+    b, s_in = input_ids.shape
+    total = s_in + max_new_tokens
+    cfg = model.config
+    vocab = cfg.vocab_size
+    p_vals = [p._value for _, p in model.named_parameters()]
+    cache_dtype = p_vals[0].dtype
+    nb = int(num_beams)
+
+    def full(pv, ids):
+        kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+                        cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
+        vc = jnp.zeros_like(kc)
+        logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)
+        logp0 = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1)  # (B, V)
+        scores0, tok0 = jax.lax.top_k(logp0, nb)          # (B, nb)
+        # beams ride the batch dim: row layout (b0beam0, b0beam1, ...)
+        kc = jnp.repeat(kc, nb, axis=1)
+        vc = jnp.repeat(vc, nb, axis=1)
+        tok = tok0.reshape(b * nb, 1).astype(jnp.int32)
+        scores = scores0.reshape(b * nb)
+        seqs = jnp.zeros((b * nb, max_new_tokens), jnp.int32)
+        seqs = seqs.at[:, 0].set(tok[:, 0])
+
+        def body(carry, i):
+            pos, tok, scores, seqs, kc, vc = carry
+            with autograd.no_grad():
+                def fwd(t_):
+                    return _manual_decode(model, t_, pos, kc, vc)
+
+                (lg, kc2, vc2), _ = functional_call(
+                    model, fwd, [Tensor(tok, stop_gradient=True)], {},
+                    pv, [])
+            logp = jax.nn.log_softmax(
+                lg[:, -1].astype(jnp.float32), axis=-1)   # (B*nb, V)
+            cand = scores[:, None] + logp                  # (B*nb, V)
+            cand = cand.reshape(b, nb * vocab)
+            new_scores, flat = jax.lax.top_k(cand, nb)     # (B, nb)
+            beam_idx = flat // vocab                       # within-group
+            new_tok = (flat % vocab).astype(jnp.int32)
+            gidx = (jnp.arange(b)[:, None] * nb + beam_idx).reshape(-1)
+            # surviving beams carry their history and caches
+            kc2 = jnp.take(kc2, gidx, axis=1)
+            vc2 = jnp.take(vc2, gidx, axis=1)
+            seqs = jnp.take(seqs, gidx, axis=0)
+            seqs = seqs.at[:, i + 1].set(new_tok.reshape(-1))
+            return (pos + 1, new_tok.reshape(b * nb, 1),
+                    new_scores.reshape(-1), seqs, kc2, vc2), None
+
+        (pos, tok, scores, seqs, _, _), _ = jax.lax.scan(
+            body, (jnp.int32(s_in), tok, scores, seqs, kc, vc),
+            jnp.arange(max_new_tokens - 1))
+        # pick the best beam per batch row (raw sum log-prob: all beams
+        # share one length in this fixed-length variant, so a length
+        # penalty cannot change the argmax — see docstring)
+        best = jnp.argmax(scores.reshape(b, nb), axis=-1)  # (B,)
+        seqs_b = seqs.reshape(b, nb, max_new_tokens)
+        gen = jnp.take_along_axis(
+            seqs_b, best[:, None, None], axis=1)[:, 0]
+        out = jnp.concatenate([ids.astype(jnp.int32), gen], axis=1)
+        best_scores = jnp.take_along_axis(
+            scores.reshape(b, nb), best[:, None], axis=1)[:, 0]
+        return out, best_scores
+
+    jitted = _model_jit_cache(
+        model,
+        ("beam", b, s_in, max_new_tokens, str(cache_dtype), nb),
+        lambda: jax.jit(full))
+    tokens, best_scores = jitted(p_vals, input_ids._value)
+    return paddle.to_tensor(tokens), paddle.to_tensor(best_scores)
+
+
+def generate(model, input_ids, max_new_tokens=32,
+             decode_strategy="greedy_search", top_k=0, top_p=1.0,
+             temperature=1.0, num_beams=1, length_penalty=1.0, seed=0,
+             **kwargs):
+    """paddle generation facade (reference:
+    paddlenlp GenerationMixin.generate — unverified, SURVEY §0):
+    routes to the on-device greedy / sampling / beam loops. Unknown
+    kwargs raise (a silently-absorbed ``eos_token_id`` or a sampling
+    knob under the default greedy strategy would otherwise produce
+    wrong-strategy output without warning; eos early-exit exists on the
+    host-loop ``greedy_search``)."""
+    if kwargs:
+        raise TypeError(
+            f"generate: unsupported kwargs {sorted(kwargs)}; on-device "
+            f"decode is fixed-length (use greedy_search for "
+            f"eos_token_id early-exit)")
+    if decode_strategy in ("greedy_search", "greedy"):
+        if (top_k and top_k > 0) or (top_p is not None and top_p < 1.0) \
+                or temperature != 1.0:
+            raise ValueError(
+                "generate: top_k/top_p/temperature require "
+                "decode_strategy='sampling' (greedy would silently "
+                "ignore them)")
+        return generate_on_device(model, input_ids, max_new_tokens)
+    if decode_strategy == "sampling":
+        return sampling_search(model, input_ids, max_new_tokens,
+                               top_k=top_k, top_p=top_p,
+                               temperature=temperature, seed=seed)
+    if decode_strategy == "beam_search":
+        out, _ = beam_search(model, input_ids, max_new_tokens,
+                             num_beams=num_beams,
+                             length_penalty=length_penalty)
+        return out
+    raise ValueError(
+        f"decode_strategy must be greedy_search|sampling|beam_search, "
+        f"got {decode_strategy!r}")

@@ -446,6 +446,15 @@ class LlamaForCausalLM(Layer):
             return logits, new_caches
         return logits
 
+    def generate(self, input_ids, max_new_tokens=32,
+                 decode_strategy="greedy_search", **kwargs):
+        """paddle-style generation entry (greedy / sampling / beam —
+        see nlp.generation.generate)."""
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens,
+                        decode_strategy=decode_strategy, **kwargs)
+
     def init_caches(self, batch_size, max_len, dtype=None):
         """Allocate empty KV caches: list of (k, v) per layer,
         (B, max_len, HK, D)."""

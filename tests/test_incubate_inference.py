@@ -132,6 +132,77 @@ def test_generation_greedy_and_on_device():
     assert (out2.numpy() == cur).all()
 
 
+def test_generation_sampling_and_beam():
+    """Round-5 decode strategies: sampling (top-k/top-p/temperature,
+    seeded) and beam search, both whole-loop on-device. Oracles:
+    top_k=1 sampling == greedy; num_beams=1 beam == greedy; a 4-beam
+    search's best sequence log-prob (teacher-forced re-score) must be
+    >= greedy's; sampling is seed-deterministic."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import (
+        generate, generate_on_device, sampling_search, beam_search,
+    )
+    import jax.numpy as jnp
+    import jax
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(0, 128, (2, 6)))
+    new = 5
+
+    greedy = generate_on_device(m, ids, max_new_tokens=new).numpy()
+
+    # top_k=1 sampling degenerates to greedy regardless of seed
+    s1 = sampling_search(m, ids, max_new_tokens=new, top_k=1, seed=3)
+    assert (s1.numpy() == greedy).all()
+
+    # seeded sampling is deterministic; different seeds eventually differ
+    a = sampling_search(m, ids, max_new_tokens=new, temperature=2.0,
+                        seed=0).numpy()
+    b = sampling_search(m, ids, max_new_tokens=new, temperature=2.0,
+                        seed=0).numpy()
+    assert (a == b).all()
+    c = sampling_search(m, ids, max_new_tokens=new, temperature=5.0,
+                        seed=7).numpy()
+    assert (c[:, :6] == greedy[:, :6]).all()  # prompt preserved
+
+    # top_p very small keeps only the argmax token → greedy
+    s2 = sampling_search(m, ids, max_new_tokens=new, top_p=1e-6, seed=9)
+    assert (s2.numpy() == greedy).all()
+
+    # beam with 1 beam == greedy
+    b1, _ = beam_search(m, ids, max_new_tokens=new, num_beams=1)
+    assert (b1.numpy() == greedy).all()
+
+    def seq_logprob(tokens_np):
+        """Teacher-forced log-prob of the generated suffix."""
+        logits = m(paddle.to_tensor(tokens_np))._value
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tot = []
+        for r in range(tokens_np.shape[0]):
+            s = 0.0
+            for t in range(6 - 1, tokens_np.shape[1] - 1):
+                s += float(lp[r, t, tokens_np[r, t + 1]])
+            tot.append(s)
+        return np.asarray(tot)
+
+    b4, scores4 = beam_search(m, ids, max_new_tokens=new, num_beams=4)
+    b4_np = b4.numpy()
+    assert (b4_np[:, :6] == greedy[:, :6]).all()
+    lp_beam = seq_logprob(b4_np)
+    lp_greedy = seq_logprob(greedy)
+    assert (lp_beam >= lp_greedy - 1e-4).all(), (lp_beam, lp_greedy)
+    # the reported cumulative scores match the teacher-forced re-score
+    np.testing.assert_allclose(scores4.numpy(), lp_beam, rtol=1e-4,
+                               atol=1e-4)
+
+    # the facade routes
+    g = generate(m, ids, max_new_tokens=new,
+                 decode_strategy="beam_search", num_beams=4).numpy()
+    assert (g == b4_np).all()
+
+
 def test_predictor_roundtrip(tmp_path):
     import paddle_tpu.inference as infer
     from paddle_tpu.static import InputSpec
