@@ -154,58 +154,79 @@ def _manual_decode(model, ids_t, offset, kc, vc):
     return logits._value, jnp.stack(new_kcs), jnp.stack(new_vcs)
 
 
-def generate_on_device(model, input_ids, max_new_tokens=32):
-    """Whole greedy decode in ONE dispatch: prefill + ``lax.scan`` of
-    single-token steps (static trip count), all inside one jitted
-    program. Caches match the model's param dtype."""
+def _ondevice_decode(model, input_ids, max_new_tokens, select,
+                     cache_tag, eos_token_id=None, pad_token_id=None,
+                     seed=0):
+    """Shared whole-loop decode driver: prefill + ``lax.scan`` of
+    single-token steps inside one jitted program, compiled once per
+    (model, cache_tag, shapes). ``select(logits, i, key) -> (B,) int32``
+    is the per-step token choice (argmax for greedy, filtered
+    categorical for sampling — the key is unused/DCE'd for greedy).
+    Rows that emit ``eos_token_id`` keep emitting ``pad_token_id``
+    (default: the eos id) for the remaining fixed-trip steps."""
     import paddle_tpu as paddle
 
-    input_ids = input_ids if isinstance(input_ids, Tensor) else paddle.to_tensor(input_ids)
+    input_ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle.to_tensor(input_ids)
     b, s_in = input_ids.shape
     total = s_in + max_new_tokens
     cfg = model.config
     p_vals = [p._value for _, p in model.named_parameters()]
     cache_dtype = p_vals[0].dtype
+    eos = None if eos_token_id is None else int(eos_token_id)
+    pad = eos if pad_token_id is None else int(pad_token_id)
 
-    # cache the compiled program on the model (a fresh closure per call
-    # would recompile every time)
-    jit_cache = getattr(model, "_generate_jit_cache", None)
-    if jit_cache is None:
-        jit_cache = model._generate_jit_cache = {}
-    cache_key = (b, s_in, max_new_tokens, str(cache_dtype))
-    if cache_key in jit_cache:
-        tokens = jit_cache[cache_key](p_vals, input_ids._value)
-        return paddle.to_tensor(tokens)
-
-    def full(pv, ids):
+    def full(pv, ids, key):
         kc = jnp.zeros((cfg.num_hidden_layers, b, total,
                         cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
         vc = jnp.zeros_like(kc)
         logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)
-        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        first = select(logits[:, -1], 0, key)[:, None]
+        done0 = jnp.zeros((b,), jnp.bool_)
 
-        def body(carry, _):
-            pos, tok, kc, vc = carry
+        def body(carry, i):
+            pos, tok, done, kc, vc = carry
             with autograd.no_grad():
                 def fwd(t_):
                     return _manual_decode(model, t_, pos, kc, vc)
 
-                (logits, kc2, vc2), _ = functional_call(
-                    model, fwd, [Tensor(tok, stop_gradient=True)], {}, pv, [])
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
-            return (pos + 1, nxt, kc2, vc2), tok[:, 0]
+                (lg, kc2, vc2), _ = functional_call(
+                    model, fwd, [Tensor(tok, stop_gradient=True)], {},
+                    pv, [])
+            nxt = select(lg[:, -1], i + 1, key)[:, None]
+            if eos is not None:
+                # a row that has emitted eos keeps emitting pad (the
+                # scan stays fixed-trip; the reference's early-exit
+                # becomes pad fill)
+                done = done | (tok[:, 0] == eos)
+                nxt = jnp.where(done[:, None], jnp.int32(pad), nxt)
+            return (pos + 1, nxt, done, kc2, vc2), tok[:, 0]
 
-        (_, last, _, _), toks = jax.lax.scan(
-            body, (jnp.int32(s_in), first, kc, vc), None,
-            length=max_new_tokens - 1)
+        (_, last, _, _, _), toks = jax.lax.scan(
+            body, (jnp.int32(s_in), first, done0, kc, vc),
+            jnp.arange(max_new_tokens - 1))
         # toks: (K-1, B) tokens at positions s_in .. total-2; append last
         gen = jnp.concatenate([toks.T, last], axis=1)
         return jnp.concatenate([ids.astype(jnp.int32), gen], axis=1)
 
-    jitted = jax.jit(full)
-    jit_cache[cache_key] = jitted
-    tokens = jitted(p_vals, input_ids._value)
+    jitted = _model_jit_cache(
+        model, cache_tag + (b, s_in, max_new_tokens, str(cache_dtype),
+                            eos, pad),
+        lambda: jax.jit(full))
+    tokens = jitted(p_vals, input_ids._value, jax.random.PRNGKey(seed))
     return paddle.to_tensor(tokens)
+
+
+def generate_on_device(model, input_ids, max_new_tokens=32,
+                       eos_token_id=None, pad_token_id=None):
+    """Whole greedy decode in ONE dispatch (see _ondevice_decode)."""
+
+    def select(logits, i, key):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _ondevice_decode(model, input_ids, max_new_tokens, select,
+                            ("greedy",), eos_token_id=eos_token_id,
+                            pad_token_id=pad_token_id)
 
 
 def _filter_logits(logits, top_k, top_p, temperature):
@@ -248,73 +269,44 @@ def _model_jit_cache(model, key, build):
 
 
 def sampling_search(model, input_ids, max_new_tokens=32, top_k=0,
-                    top_p=1.0, temperature=1.0, seed=0):
+                    top_p=1.0, temperature=1.0, seed=0,
+                    eos_token_id=None, pad_token_id=None):
     """Whole SAMPLING decode in one dispatch (reference:
     generation_utils' decode_strategy="sampling" — unverified, SURVEY
-    §0): prefill + lax.scan of single-token steps, each drawing from
-    the temperature/top-k/top-p-filtered distribution with a per-step
-    fold_in of the seed. Deterministic given (seed, inputs)."""
-    import paddle_tpu as paddle
+    §0): each step draws from the temperature/top-k/top-p-filtered
+    distribution with a per-step fold_in of the seed; deterministic
+    given (seed, inputs). None for a knob disables it. See
+    _ondevice_decode for the loop/eos mechanics."""
+    top_k = 0 if top_k is None else int(top_k)
+    top_p = 1.0 if top_p is None else float(top_p)
+    temperature = 1.0 if temperature is None else float(temperature)
 
-    input_ids = input_ids if isinstance(input_ids, Tensor) \
-        else paddle.to_tensor(input_ids)
-    b, s_in = input_ids.shape
-    total = s_in + max_new_tokens
-    cfg = model.config
-    p_vals = [p._value for _, p in model.named_parameters()]
-    cache_dtype = p_vals[0].dtype
+    def select(logits, i, key):
+        filt = _filter_logits(logits, top_k, top_p, temperature)
+        return jax.random.categorical(
+            jax.random.fold_in(key, i), filt).astype(jnp.int32)
 
-    def full(pv, ids, key):
-        kc = jnp.zeros((cfg.num_hidden_layers, b, total,
-                        cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
-        vc = jnp.zeros_like(kc)
-        logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)
-        filt = _filter_logits(logits[:, -1], top_k, top_p, temperature)
-        first = jax.random.categorical(
-            jax.random.fold_in(key, 0), filt).astype(jnp.int32)[:, None]
-
-        def body(carry, i):
-            pos, tok, kc, vc = carry
-            with autograd.no_grad():
-                def fwd(t_):
-                    return _manual_decode(model, t_, pos, kc, vc)
-
-                (lg, kc2, vc2), _ = functional_call(
-                    model, fwd, [Tensor(tok, stop_gradient=True)], {},
-                    pv, [])
-            filt = _filter_logits(lg[:, -1], top_k, top_p, temperature)
-            nxt = jax.random.categorical(
-                jax.random.fold_in(key, i + 1), filt
-            ).astype(jnp.int32)[:, None]
-            return (pos + 1, nxt, kc2, vc2), tok[:, 0]
-
-        (_, last, _, _), toks = jax.lax.scan(
-            body, (jnp.int32(s_in), first, kc, vc),
-            jnp.arange(max_new_tokens - 1))
-        gen = jnp.concatenate([toks.T, last], axis=1)
-        return jnp.concatenate([ids.astype(jnp.int32), gen], axis=1)
-
-    jitted = _model_jit_cache(
-        model,
-        ("sampling", b, s_in, max_new_tokens, str(cache_dtype),
-         int(top_k), float(top_p), float(temperature)),
-        lambda: jax.jit(full))
-    tokens = jitted(p_vals, input_ids._value, jax.random.PRNGKey(seed))
-    return paddle.to_tensor(tokens)
+    return _ondevice_decode(
+        model, input_ids, max_new_tokens, select,
+        ("sampling", top_k, top_p, temperature),
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed)
 
 
 def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
-                length_penalty=1.0):
+                length_penalty=1.0, eos_token_id=None, pad_token_id=None):
     """Whole BEAM-SEARCH decode in one dispatch (reference:
     generation_utils' decode_strategy="beam_search" — unverified,
     SURVEY §0): beams ride the batch dim (B*num_beams rows), the scan
     step reorders the stacked KV caches with the surviving beams'
-    indices, and the best beam per batch row is returned. Fixed-length
-    variant: sequences run to max_new_tokens (no early eos
-    retirement) — NOTE all beams therefore share one length, so
-    ``length_penalty`` cannot change the argmax today; the parameter is
-    kept for the paddle API shape and becomes live once variable-length
-    (eos-retiring) decode exists."""
+    indices, and the best beam per batch row — sum log-prob divided by
+    generated length ** ``length_penalty`` — is returned.
+
+    With ``eos_token_id``, a beam that emits it RETIRES: its score
+    freezes, its only continuation is ``pad_token_id`` (default: eos)
+    at zero cost, and its generated length stops growing — so beams
+    end at different lengths and the length penalty is live. Without
+    eos all beams share one length and the penalty cannot change the
+    argmax."""
     import paddle_tpu as paddle
 
     input_ids = input_ids if isinstance(input_ids, Tensor) \
@@ -326,6 +318,8 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
     p_vals = [p._value for _, p in model.named_parameters()]
     cache_dtype = p_vals[0].dtype
     nb = int(num_beams)
+    eos = None if eos_token_id is None else int(eos_token_id)
+    pad = eos if pad_token_id is None else int(pad_token_id)
 
     def full(pv, ids):
         kc = jnp.zeros((cfg.num_hidden_layers, b, total,
@@ -342,9 +336,11 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
         scores = scores0.reshape(b * nb)
         seqs = jnp.zeros((b * nb, max_new_tokens), jnp.int32)
         seqs = seqs.at[:, 0].set(tok[:, 0])
+        done0 = jnp.zeros((b * nb,), jnp.bool_)
+        lens0 = jnp.ones((b * nb,), jnp.int32)
 
         def body(carry, i):
-            pos, tok, scores, seqs, kc, vc = carry
+            pos, tok, scores, seqs, done, lens, kc, vc = carry
             with autograd.no_grad():
                 def fwd(t_):
                     return _manual_decode(model, t_, pos, kc, vc)
@@ -354,6 +350,13 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
                     pv, [])
             logp = jax.nn.log_softmax(
                 lg[:, -1].astype(jnp.float32), axis=-1)   # (B*nb, V)
+            if eos is not None:
+                done = done | (tok[:, 0] == eos)
+                # retired beams: single zero-cost pad continuation (any
+                # other child would duplicate the frozen hypothesis)
+                logp = jnp.where(done[:, None], -jnp.inf, logp)
+                logp = logp.at[:, pad].set(
+                    jnp.where(done, 0.0, logp[:, pad]))
             cand = scores[:, None] + logp                  # (B*nb, V)
             cand = cand.reshape(b, nb * vocab)
             new_scores, flat = jax.lax.top_k(cand, nb)     # (B, nb)
@@ -365,16 +368,23 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
             vc2 = jnp.take(vc2, gidx, axis=1)
             seqs = jnp.take(seqs, gidx, axis=0)
             seqs = seqs.at[:, i + 1].set(new_tok.reshape(-1))
+            done = jnp.take(done, gidx, axis=0)
+            lens = jnp.take(lens, gidx, axis=0)
+            lens = lens + (~done).astype(jnp.int32)
             return (pos + 1, new_tok.reshape(b * nb, 1),
-                    new_scores.reshape(-1), seqs, kc2, vc2), None
+                    new_scores.reshape(-1), seqs, done, lens, kc2,
+                    vc2), None
 
-        (pos, tok, scores, seqs, _, _), _ = jax.lax.scan(
-            body, (jnp.int32(s_in), tok, scores, seqs, kc, vc),
+        (pos, tok, scores, seqs, done, lens, _, _), _ = jax.lax.scan(
+            body, (jnp.int32(s_in), tok, scores, seqs, done0, lens0,
+                   kc, vc),
             jnp.arange(max_new_tokens - 1))
-        # pick the best beam per batch row (raw sum log-prob: all beams
-        # share one length in this fixed-length variant, so a length
-        # penalty cannot change the argmax — see docstring)
-        best = jnp.argmax(scores.reshape(b, nb), axis=-1)  # (B,)
+        # best beam per batch row: sum log-prob over generated length ^
+        # penalty (lengths differ only when eos retirement happened)
+        norm = scores.reshape(b, nb) / (
+            lens.reshape(b, nb).astype(jnp.float32)
+            ** jnp.float32(length_penalty))
+        best = jnp.argmax(norm, axis=-1)                   # (B,)
         seqs_b = seqs.reshape(b, nb, max_new_tokens)
         gen = jnp.take_along_axis(
             seqs_b, best[:, None, None], axis=1)[:, 0]
@@ -385,7 +395,8 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
 
     jitted = _model_jit_cache(
         model,
-        ("beam", b, s_in, max_new_tokens, str(cache_dtype), nb),
+        ("beam", b, s_in, max_new_tokens, str(cache_dtype), nb,
+         float(length_penalty), eos, pad),
         lambda: jax.jit(full))
     tokens, best_scores = jitted(p_vals, input_ids._value)
     return paddle.to_tensor(tokens), paddle.to_tensor(best_scores)
@@ -394,35 +405,51 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
 def generate(model, input_ids, max_new_tokens=32,
              decode_strategy="greedy_search", top_k=0, top_p=1.0,
              temperature=1.0, num_beams=1, length_penalty=1.0, seed=0,
-             **kwargs):
+             eos_token_id=None, pad_token_id=None, **kwargs):
     """paddle generation facade (reference:
     paddlenlp GenerationMixin.generate — unverified, SURVEY §0):
-    routes to the on-device greedy / sampling / beam loops. Unknown
-    kwargs raise (a silently-absorbed ``eos_token_id`` or a sampling
-    knob under the default greedy strategy would otherwise produce
-    wrong-strategy output without warning; eos early-exit exists on the
-    host-loop ``greedy_search``)."""
+    routes to the on-device greedy / sampling / beam loops. Rows (or
+    beams) that emit ``eos_token_id`` pad out / retire. Unknown kwargs
+    raise — a silently-absorbed sampling knob under the default greedy
+    strategy would otherwise produce wrong-strategy output without
+    warning."""
     if kwargs:
         raise TypeError(
-            f"generate: unsupported kwargs {sorted(kwargs)}; on-device "
-            f"decode is fixed-length (use greedy_search for "
-            f"eos_token_id early-exit)")
+            f"generate: unsupported kwargs {sorted(kwargs)}")
+    sampling_knobs = ((top_k or 0) > 0
+                      or (top_p is not None and top_p < 1.0)
+                      or (temperature is not None and temperature != 1.0))
+    beam_knobs = num_beams != 1 or length_penalty != 1.0
     if decode_strategy in ("greedy_search", "greedy"):
-        if (top_k and top_k > 0) or (top_p is not None and top_p < 1.0) \
-                or temperature != 1.0:
+        if sampling_knobs or beam_knobs:
             raise ValueError(
-                "generate: top_k/top_p/temperature require "
-                "decode_strategy='sampling' (greedy would silently "
-                "ignore them)")
-        return generate_on_device(model, input_ids, max_new_tokens)
+                "generate: sampling/beam knobs require "
+                "decode_strategy='sampling'/'beam_search' (greedy would "
+                "silently ignore them)")
+        return generate_on_device(model, input_ids, max_new_tokens,
+                                  eos_token_id=eos_token_id,
+                                  pad_token_id=pad_token_id)
     if decode_strategy == "sampling":
+        if beam_knobs:
+            raise ValueError(
+                "generate: num_beams/length_penalty require "
+                "decode_strategy='beam_search'")
         return sampling_search(model, input_ids, max_new_tokens,
                                top_k=top_k, top_p=top_p,
-                               temperature=temperature, seed=seed)
+                               temperature=temperature, seed=seed,
+                               eos_token_id=eos_token_id,
+                               pad_token_id=pad_token_id)
     if decode_strategy == "beam_search":
+        if sampling_knobs:
+            raise ValueError(
+                "generate: top_k/top_p/temperature require "
+                "decode_strategy='sampling' (beam search would silently "
+                "ignore them)")
         out, _ = beam_search(model, input_ids, max_new_tokens,
                              num_beams=num_beams,
-                             length_penalty=length_penalty)
+                             length_penalty=length_penalty,
+                             eos_token_id=eos_token_id,
+                             pad_token_id=pad_token_id)
         return out
     raise ValueError(
         f"decode_strategy must be greedy_search|sampling|beam_search, "

@@ -67,6 +67,52 @@ def test_fused_lce_bias():
     np.testing.assert_allclose(loss, loss_ref, rtol=1e-6)
 
 
+def test_fused_lce_under_tensor_parallel_matches_serial():
+    """The fused criterion composed with TP (mp2 x dp) on the 8-device
+    mesh: the llama model's mp-sharded layers + fused lm-head+CE must
+    reproduce the mesh-less serial fused run AND the serial unfused run
+    over 2 jitted train steps — the hybrid-parallel pretrain recipe the
+    north-star config would use."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.parallel import mesh as mesh_state
+    from paddle_tpu.nlp import (
+        LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+    )
+    from paddle_tpu.jit.train import JittedTrainStep
+
+    ids_np = np.random.RandomState(0).randint(0, 128, (4, 32))
+
+    def run(mesh, fuse):
+        mesh_state.set_mesh(None)
+        if mesh:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {
+                "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                "sharding_degree": 1,
+            }
+            fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(tensor_parallel=True,
+                               fuse_linear_cross_entropy=fuse)
+        model = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(
+            cfg, lm_head=model.lm_head if fuse else None)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        step = JittedTrainStep(model, lambda o, l: crit(o, l), opt)
+        ids = paddle.to_tensor(ids_np)
+        losses = [float(step(ids, ids)) for _ in range(2)]
+        mesh_state.set_mesh(None)
+        return losses
+
+    serial_unfused = run(False, False)
+    serial_fused = run(False, True)
+    tp_fused = run(True, True)
+    np.testing.assert_allclose(serial_fused, serial_unfused,
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(tp_fused, serial_fused,
+                               rtol=2e-4, atol=1e-5)
+
+
 @pytest.mark.parametrize("packed", [False, True])
 def test_llama_fused_criterion_matches_unfused_train(packed):
     """Two jitted train steps at tiny shape: fused-loss config must track

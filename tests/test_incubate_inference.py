@@ -203,6 +203,65 @@ def test_generation_sampling_and_beam():
     assert (g == b4_np).all()
 
 
+def test_generation_eos_padding_and_retirement():
+    """eos handling on the on-device loops: once a row emits the eos
+    token, every later position is pad (greedy + sampling), and a
+    retired beam's score freezes (its padded continuation adds zero
+    log-prob)."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import (
+        generate_on_device, sampling_search, beam_search,
+    )
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(2).randint(0, 128, (2, 6)))
+    new = 6
+
+    plain = generate_on_device(m, ids, max_new_tokens=new).numpy()
+    # pick the token row 0 greedily emits at step 1 as the "eos"
+    eos = int(plain[0, 6 + 1])
+    pad = 77
+    out = generate_on_device(m, ids, max_new_tokens=new,
+                             eos_token_id=eos, pad_token_id=pad).numpy()
+    for r in range(out.shape[0]):
+        gen = out[r, 6:]
+        hits = np.nonzero(gen == eos)[0]
+        if len(hits):
+            after = gen[hits[0] + 1:]
+            assert (after == pad).all(), (r, gen)
+    # row 0 definitely hit it at step 1 → tail is all pad
+    assert (out[0, 6 + 2:] == pad).all()
+    # prefix up to and including eos matches the plain run
+    assert (out[0, : 6 + 2] == plain[0, : 6 + 2]).all()
+
+    # sampling honors eos the same way (top_k=1 = greedy path)
+    s = sampling_search(m, ids, max_new_tokens=new, top_k=1,
+                        eos_token_id=eos, pad_token_id=pad).numpy()
+    assert (s == out).all()
+
+    # beam: with eos, the best beam's reported score must equal the
+    # teacher-forced log-prob of its tokens UP TO eos (frozen after)
+    b4, scores = beam_search(m, ids, max_new_tokens=new, num_beams=3,
+                             eos_token_id=eos, pad_token_id=pad)
+    b4_np, scores_np = b4.numpy(), scores.numpy()
+    import jax
+    import jax.numpy as jnp
+
+    logits = m(paddle.to_tensor(b4_np))._value
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    for r in range(b4_np.shape[0]):
+        s_val = 0.0
+        for t in range(5, b4_np.shape[1] - 1):
+            nxt = b4_np[r, t + 1]
+            s_val += float(lp[r, t, nxt])
+            if nxt == eos:
+                break
+        np.testing.assert_allclose(scores_np[r], s_val, rtol=1e-4,
+                                   atol=1e-4)
+
+
 def test_predictor_roundtrip(tmp_path):
     import paddle_tpu.inference as infer
     from paddle_tpu.static import InputSpec
