@@ -176,3 +176,36 @@ class GPTForCausalLM(Layer):
             # lm_head is applied inside the fused criterion
             return hidden
         return self.lm_head(hidden)
+
+    def generate(self, input_ids, max_new_tokens=32,
+                 decode_strategy="greedy_search", eos_token_id=None,
+                 **kwargs):
+        """paddle-style generation entry — see nlp.generation.generate.
+        Only the host greedy loop applies (GPT has no KV-cache decode
+        path wired into the on-device loops yet): plain greedy via
+        repeated full forwards, with optional eos early-exit. Unknown
+        kwargs raise (same contract as nlp.generation.generate)."""
+        import numpy as np
+        import paddle_tpu as paddle
+
+        if kwargs:
+            raise TypeError(
+                f"GPT generate: unsupported kwargs {sorted(kwargs)}")
+        if decode_strategy not in ("greedy_search", "greedy"):
+            raise NotImplementedError(
+                "GPT generate supports greedy_search only (the on-device "
+                "sampling/beam loops ride the llama KV-cache decode)")
+        cur = np.asarray(input_ids.numpy() if hasattr(input_ids, "numpy")
+                         else input_ids)
+        for _ in range(max_new_tokens):
+            # call the submodules directly: under
+            # fuse_linear_cross_entropy, forward() returns HIDDEN states
+            # (the training-loss contract) — generation always needs
+            # the lm_head applied
+            hidden = self.gpt(paddle.to_tensor(cur))
+            logits = self.lm_head(hidden)
+            nxt = logits.numpy()[:, -1].argmax(-1)[:, None]
+            cur = np.concatenate([cur, nxt], axis=1)
+            if eos_token_id is not None and (nxt == eos_token_id).all():
+                break
+        return paddle.to_tensor(cur)

@@ -47,7 +47,8 @@ class LlamaConfig:
                  tensor_parallel=True, sequence_parallel=False,
                  context_parallel=None, use_recompute=False,
                  recompute_granularity="full", dtype="float32",
-                 fuse_linear_cross_entropy=False, lce_chunk_rows=1024):
+                 fuse_linear_cross_entropy=False, lce_chunk_rows=1024,
+                 sliding_window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -72,6 +73,11 @@ class LlamaConfig:
         # chunk_rows * vocab * 4)
         self.fuse_linear_cross_entropy = fuse_linear_cross_entropy
         self.lce_chunk_rows = lce_chunk_rows
+        # causal sliding-window attention (Mistral semantics): each
+        # query attends to the last `sliding_window` tokens. Training /
+        # prefill path only; KV-cache decode with a rolling buffer is a
+        # documented non-goal for now (forward raises on the combo).
+        self.sliding_window = sliding_window
 
     @property
     def head_dim(self):
@@ -179,6 +185,12 @@ class LlamaAttention(Layer):
                       op_name="rope_k")
 
         if cu_seqlens is not None:
+            if self.config.sliding_window:
+                raise NotImplementedError(
+                    "sliding_window + packed cu_seqlens training is not "
+                    "implemented (the varlen kernel has no band tiles "
+                    "yet); train dense with the window or packed "
+                    "without it")
             # packed ragged sequences, (B=1, T) layout: the Pallas varlen
             # kernel skips dead cross-segment tiles AND their KV DMA
             # (ops/pallas/varlen_flash_attention.py)
@@ -191,10 +203,18 @@ class LlamaAttention(Layer):
                 scale=1.0 / math.sqrt(self.head_dim), causal=True)
             out = out.reshape([b, s, self.num_heads, self.head_dim])
         elif cache is not None:
+            if self.config.sliding_window:
+                raise NotImplementedError(
+                    "sliding_window + KV-cache decode needs a rolling "
+                    "cache buffer — not implemented; decode without the "
+                    "window or use the training/prefill path")
             # incremental decode: cache is (k_cache, v_cache) Tensors laid
             # out (B, S_max, HK, D) with valid length = position_offset + s
             k, v, cache = self._update_cache(k, v, cache, position_offset)
             out = self._decode_attend(q, k, v, position_offset + s)
+        elif self.config.sliding_window:
+            out = F.sliding_window_attention(
+                q, k, v, self.config.sliding_window)
         elif (self.config.context_parallel
               and mesh_state.mesh_axis_size("sep") > 1):
             from ..distributed.fleet.meta_parallel.context_parallel import (

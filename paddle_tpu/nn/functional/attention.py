@@ -54,6 +54,50 @@ def _xla_attention(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
     return out.astype(q.dtype)
 
 
+def sliding_window_attention(query, key, value, window_size,
+                             training=True, name=None):
+    """Causal sliding-window attention (Mistral semantics: each query
+    attends to the last ``window_size`` keys, itself included). Routes
+    to the Pallas flash kernel's banded tiles on TPU (cost
+    O(S * window)); elsewhere an XLA banded-mask fallback."""
+    query, key_, value = (ensure_tensor(query), ensure_tensor(key),
+                          ensure_tensor(value))
+    w = int(window_size)
+    if w < 1:
+        # validated HERE: the kernel's own ValueError would be swallowed
+        # by the capability-fallback except below, and the XLA path's
+        # empty band would softmax to NaN
+        raise ValueError(f"window_size must be >= 1, got {window_size}")
+    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
+    use_pallas = (
+        flags["FLAGS_use_pallas_kernels"]
+        and (jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
+        and query._value.shape[-1] >= 64
+    )
+    if use_pallas:
+        try:
+            return apply(
+                lambda q, k, v: _pallas_flash(q, k, v, causal=True,
+                                              window_size=w),
+                query, key_, value, op_name="sliding_window_attention",
+            )
+        except ValueError as e:
+            warnings.warn(
+                f"Pallas sliding-window attention fell back to XLA: {e}",
+                RuntimeWarning)
+
+    def fn(q, k, v):
+        sq, sk = q.shape[1], k.shape[1]
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        kpos = jnp.arange(sk)[None, :]
+        band = (kpos <= qpos) & (kpos >= qpos - w + 1)
+        return _xla_attention(q, k, v, mask=band[None, None], causal=False,
+                              dropout_p=0.0, key=None)
+
+    return apply(fn, query, key_, value,
+                 op_name="sliding_window_attention")
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
@@ -192,4 +236,5 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
 __all__ = [
     "scaled_dot_product_attention", "flash_attention", "flash_attn_unpadded",
+    "sliding_window_attention",
 ]

@@ -48,23 +48,35 @@ def _default_blocks(head_dim):
 
 
 
-def _run_full(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
+def _run_full(qi, ki, block_q, block_k, causal, causal_offset, kv_len,
+              window=None):
     """(run, full) tile validity: ``run`` = the tile contributes at all
-    (not past the kv length / not entirely above the causal diagonal);
+    (not past the kv length / not entirely outside the causal band);
     ``full`` = every (q, k) pair in the tile is valid, i.e. exactly the
     condition under which _mask_for_block is all-true — interior tiles
     skip the mask build. Shared by fwd/dq/dkv so the boundary math can
-    never desynchronize between forward and backward."""
+    never desynchronize between forward and backward. ``window`` (with
+    causal) restricts each query to the last ``window`` keys — tiles
+    entirely BELOW the band are skipped too, making long-sequence
+    sliding-window cost O(S * window)."""
     run = ki * block_k < kv_len
     full = (ki + 1) * block_k <= kv_len
     if causal:
         run = run & (ki * block_k <= (qi + 1) * block_q - 1 + causal_offset)
         full = full & (
             (ki + 1) * block_k - 1 <= qi * block_q + causal_offset)
+        if window is not None:
+            # band lower edge: k_pos >= q_pos + causal_offset - window + 1
+            run = run & ((ki + 1) * block_k - 1
+                         >= qi * block_q + causal_offset - window + 1)
+            full = full & (
+                ki * block_k
+                >= (qi + 1) * block_q - 1 + causal_offset - window + 1)
     return run, full
 
 
-def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
+def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len,
+                    window=None):
     """Boolean validity mask (BQ, BK) for one (q-block, kv-block) tile."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
@@ -75,6 +87,8 @@ def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
     mask = k_pos < kv_len
     if causal:
         mask = mask & (q_pos + causal_offset >= k_pos)
+        if window is not None:
+            mask = mask & (k_pos >= q_pos + causal_offset - window + 1)
     return mask
 
 
@@ -83,7 +97,7 @@ def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len):
 # --------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, causal, causal_offset, kv_len,
-                sm_scale, block_q, block_k, kv_steps):
+                sm_scale, block_q, block_k, kv_steps, window=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -98,7 +112,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # dominates diagonal-heavy causal grids (round-5 fix, mirroring the
     # varlen kernel's run/full split)
     run, full = _run_full(qi, ki, block_q, block_k, causal, causal_offset,
-                          kv_len)
+                          kv_len, window)
 
     def _accumulate(masked):
         # matmul INPUTS stay in the storage dtype (bf16 on TPU) with f32
@@ -115,7 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         ) * sm_scale  # (BQ, BK) f32
         if masked:
             mask = _mask_for_block(qi, ki, block_q, block_k, causal,
-                                   causal_offset, kv_len)
+                                   causal_offset, kv_len, window)
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]  # (BQ, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -150,7 +164,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
-               block_q, block_k):
+               block_q, block_k, window=None):
     """q: (B,H,Sq,D) block-multiple padded; k/v: (B,HK,Sk,D)."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
@@ -162,6 +176,7 @@ def _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
         _fwd_kernel, causal=causal, causal_offset=causal_offset,
         kv_len=kv_len, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, kv_steps=kv_steps,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -196,7 +211,7 @@ def _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
 # --------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, causal, causal_offset, kv_len, sm_scale,
-                   block_q, block_k, kv_steps):
+                   block_q, block_k, kv_steps, window=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -205,7 +220,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     run, full = _run_full(qi, ki, block_q, block_k, causal, causal_offset,
-                          kv_len)
+                          kv_len, window)
 
     def _body(masked):
         # storage-dtype matmul inputs + f32 accumulation (see _fwd_kernel)
@@ -221,7 +236,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         if masked:
             mask = _mask_for_block(qi, ki, block_q, block_k, causal,
-                                   causal_offset, kv_len)
+                                   causal_offset, kv_len, window)
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -250,7 +265,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # --------------------------------------------------------------------------
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal, causal_offset,
-                    kv_len, sm_scale, block_q, block_k, q_steps):
+                    kv_len, sm_scale, block_q, block_k, q_steps, window=None):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -260,7 +275,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     run, full = _run_full(qi, ki, block_q, block_k, causal, causal_offset,
-                          kv_len)
+                          kv_len, window)
 
     def _body(masked):
         # storage-dtype matmul inputs + f32 accumulation (see _fwd_kernel)
@@ -276,7 +291,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)  # (BQ, BK) f32
         if masked:
             mask = _mask_for_block(qi, ki, block_q, block_k, causal,
-                                   causal_offset, kv_len)
+                                   causal_offset, kv_len, window)
             p = jnp.where(mask, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -306,7 +321,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
-               residuals, g):
+               window, residuals, g):
     q, k, v, out, lse = residuals
     do = g[0] if isinstance(g, tuple) else g
     b, h, sq, d = q.shape
@@ -331,7 +346,8 @@ def _flash_bwd(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
     )
 
     common = dict(causal=causal, causal_offset=causal_offset, kv_len=kv_len,
-                  sm_scale=sm_scale, block_q=block_q, block_k=block_k)
+                  sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                  window=window)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, kv_steps=kv_steps, **common),
@@ -391,38 +407,48 @@ def _flash_bwd(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
 # --------------------------------------------------------------------------
 # public API
 # --------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_attention_bhsd(q, k, v, causal, causal_offset, kv_len, sm_scale,
-                          block_q, block_k):
+                          block_q, block_k, window=None):
     out, _ = _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
-                        block_q, block_k)
+                        block_q, block_k, window)
     return out
 
 
 def _fwd_rule(q, k, v, causal, causal_offset, kv_len, sm_scale,
-              block_q, block_k):
+              block_q, block_k, window=None):
     out, lse = _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
-                          block_q, block_k)
+                          block_q, block_k, window)
     return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
-              residuals, g):
+              window, residuals, g):
     return _flash_bwd(causal, causal_offset, kv_len, sm_scale,
-                      block_q, block_k, residuals, g)
+                      block_q, block_k, window, residuals, g)
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    block_q=None, block_k=None):
+                    block_q=None, block_k=None, window_size=None):
     """Flash attention over paddle layout (B, S, H, D).
 
     Supports GQA/MQA (H a multiple of HK), cross-attention lengths
-    (bottom-right causal alignment), and arbitrary sequence lengths
-    (internally padded to block multiples).
+    (bottom-right causal alignment), arbitrary sequence lengths
+    (internally padded to block multiples), and causal SLIDING-WINDOW
+    attention (``window_size`` = the number of most-recent keys each
+    query may attend to, itself included — Mistral semantics; tiles
+    entirely outside the band are skipped, so cost is O(S * window)).
     """
+    if window_size is not None:
+        if not causal:
+            raise ValueError(
+                "window_size requires causal=True (a non-causal window "
+                "is ambiguous about its anchor)")
+        if window_size < 1:
+            raise ValueError(f"window_size must be >= 1, got {window_size}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if block_q is None or block_k is None:
@@ -446,8 +472,9 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
         kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
     causal_offset = sk - sq  # bottom-right alignment, real lengths
+    win = None if window_size is None else int(window_size)
     out = _flash_attention_bhsd(qt, kt, vt, causal, causal_offset, sk,
-                                sm_scale, bq, bk)
+                                sm_scale, bq, bk, win)
     if pad_q:
         out = out[:, :, :sq]
     return jnp.swapaxes(out, 1, 2)
