@@ -55,7 +55,9 @@ def greedy_search(model, input_ids, max_new_tokens=32, max_length=None,
     cfg = model.config
     p_vals = [p._value for _, p in model.named_parameters()]
 
-    kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+    cache_len = (min(total, cfg.sliding_window)
+                 if getattr(cfg, "sliding_window", None) else total)
+    kc = jnp.zeros((cfg.num_hidden_layers, b, cache_len,
                     cfg.num_key_value_heads, cfg.head_dim), jnp.float32)
     vc = jnp.zeros_like(kc)
 
@@ -108,6 +110,8 @@ def _manual_decode(model, ids_t, offset, kc, vc):
     core = model.llama
     hidden = core.embed_tokens(ids_t)
     b, s, _ = hidden.shape
+    cache_len = kc.shape[2]  # (L, B, S_cache, HK, D)
+    windowed = bool(getattr(cfg, "sliding_window", None))
     h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                 cfg.head_dim)
 
@@ -128,16 +132,20 @@ def _manual_decode(model, ids_t, offset, kc, vc):
         qv = apply_rotary_emb(q._value, cos, sin)
         kv = apply_rotary_emb(k._value, cos, sin)
 
+        write_pos = (offset.astype(jnp.int32) % cache_len
+                     if windowed else offset.astype(jnp.int32))
         kci = jax.lax.dynamic_update_slice(
-            kc[i], kv.astype(kc.dtype)[:, :],
-            (0, offset.astype(jnp.int32), 0, 0))
+            kc[i], kv.astype(kc.dtype)[:, :], (0, write_pos, 0, 0))
         vci = jax.lax.dynamic_update_slice(
-            vc[i], v._value.astype(vc.dtype),
-            (0, offset.astype(jnp.int32), 0, 0))
+            vc[i], v._value.astype(vc.dtype), (0, write_pos, 0, 0))
         new_kcs.append(kci)
         new_vcs.append(vci)
 
         lens = jnp.full((b,), offset + s, jnp.int32)
+        if windowed:
+            # rolling buffer: a single query attends every live slot
+            # (wrapped order is irrelevant to softmax)
+            lens = jnp.minimum(lens, cache_len)
         if jax.default_backend() == "tpu":
             from ..ops.pallas.decode_attention import decode_attention
 
@@ -176,8 +184,11 @@ def _ondevice_decode(model, input_ids, max_new_tokens, select,
     eos = None if eos_token_id is None else int(eos_token_id)
     pad = eos if pad_token_id is None else int(pad_token_id)
 
+    cache_len = (min(total, cfg.sliding_window)
+                 if getattr(cfg, "sliding_window", None) else total)
+
     def full(pv, ids, key):
-        kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+        kc = jnp.zeros((cfg.num_hidden_layers, b, cache_len,
                         cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
         vc = jnp.zeros_like(kc)
         logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)
@@ -321,8 +332,11 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
     eos = None if eos_token_id is None else int(eos_token_id)
     pad = eos if pad_token_id is None else int(pad_token_id)
 
+    cache_len = (min(total, cfg.sliding_window)
+                 if getattr(cfg, "sliding_window", None) else total)
+
     def full(pv, ids):
-        kc = jnp.zeros((cfg.num_hidden_layers, b, total,
+        kc = jnp.zeros((cfg.num_hidden_layers, b, cache_len,
                         cfg.num_key_value_heads, cfg.head_dim), cache_dtype)
         vc = jnp.zeros_like(kc)
         logits, kc, vc = _logits_fn(model, pv, ids, 0, kc, vc)

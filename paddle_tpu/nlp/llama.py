@@ -48,7 +48,7 @@ class LlamaConfig:
                  context_parallel=None, use_recompute=False,
                  recompute_granularity="full", dtype="float32",
                  fuse_linear_cross_entropy=False, lce_chunk_rows=1024,
-                 sliding_window=None):
+                 sliding_window=None, attention_bias=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -74,10 +74,14 @@ class LlamaConfig:
         self.fuse_linear_cross_entropy = fuse_linear_cross_entropy
         self.lce_chunk_rows = lce_chunk_rows
         # causal sliding-window attention (Mistral semantics): each
-        # query attends to the last `sliding_window` tokens. Training /
-        # prefill path only; KV-cache decode with a rolling buffer is a
-        # documented non-goal for now (forward raises on the combo).
+        # query attends to the last `sliding_window` tokens. Training
+        # and prefill use the banded flash kernel; decode runs against
+        # a ROLLING KV buffer of window length (init_caches clamps).
+        # Chunked prefill (cache, offset>0, s>1) raises; packed
+        # cu_seqlens + window raises (no band varlen tiles yet).
         self.sliding_window = sliding_window
+        # Qwen2-style: q/k/v projections carry biases (o_proj does not)
+        self.attention_bias = attention_bias
 
     @property
     def head_dim(self):
@@ -97,6 +101,30 @@ class LlamaConfig:
         cfg = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
                    num_hidden_layers=2, num_attention_heads=4,
                    num_key_value_heads=2, max_position_embeddings=256)
+        cfg.update(overrides)
+        return LlamaConfig(**cfg)
+
+    @staticmethod
+    def mistral_7b(**overrides):
+        """Mistral-7B shape: GQA 32/8 + sliding-window 4096 on the
+        same decoder stack (the architectures differ only in config)."""
+        cfg = dict(vocab_size=32000, hidden_size=4096,
+                   intermediate_size=14336, num_hidden_layers=32,
+                   num_attention_heads=32, num_key_value_heads=8,
+                   max_position_embeddings=32768, rope_theta=10000.0,
+                   sliding_window=4096)
+        cfg.update(overrides)
+        return LlamaConfig(**cfg)
+
+    @staticmethod
+    def qwen2_7b(**overrides):
+        """Qwen2-7B shape: GQA 28/4 with q/k/v biases
+        (attention_bias) on the same decoder stack."""
+        cfg = dict(vocab_size=152064, hidden_size=3584,
+                   intermediate_size=18944, num_hidden_layers=28,
+                   num_attention_heads=28, num_key_value_heads=4,
+                   max_position_embeddings=32768, rope_theta=1000000.0,
+                   attention_bias=True)
         cfg.update(overrides)
         return LlamaConfig(**cfg)
 
@@ -138,24 +166,31 @@ class LlamaAttention(Layer):
         h, hk, d = (config.num_attention_heads, config.num_key_value_heads,
                     config.head_dim)
         self.num_heads, self.num_kv_heads, self.head_dim = h, hk, d
+        qkv_bias = bool(getattr(config, "attention_bias", False))
         if _use_mp(config):
             from ..distributed.fleet.layers.mpu.mp_layers import (
                 ColumnParallelLinear, RowParallelLinear,
             )
 
             self.q_proj = ColumnParallelLinear(
-                config.hidden_size, h * d, has_bias=False, gather_output=False)
+                config.hidden_size, h * d, has_bias=qkv_bias,
+                gather_output=False)
             self.k_proj = ColumnParallelLinear(
-                config.hidden_size, hk * d, has_bias=False, gather_output=False)
+                config.hidden_size, hk * d, has_bias=qkv_bias,
+                gather_output=False)
             self.v_proj = ColumnParallelLinear(
-                config.hidden_size, hk * d, has_bias=False, gather_output=False)
+                config.hidden_size, hk * d, has_bias=qkv_bias,
+                gather_output=False)
             self.o_proj = RowParallelLinear(
                 h * d, config.hidden_size, has_bias=False,
                 input_is_parallel=True)
         else:
-            self.q_proj = Linear(config.hidden_size, h * d, bias_attr=False)
-            self.k_proj = Linear(config.hidden_size, hk * d, bias_attr=False)
-            self.v_proj = Linear(config.hidden_size, hk * d, bias_attr=False)
+            self.q_proj = Linear(config.hidden_size, h * d,
+                                 bias_attr=qkv_bias or False)
+            self.k_proj = Linear(config.hidden_size, hk * d,
+                                 bias_attr=qkv_bias or False)
+            self.v_proj = Linear(config.hidden_size, hk * d,
+                                 bias_attr=qkv_bias or False)
             self.o_proj = Linear(h * d, config.hidden_size, bias_attr=False)
 
     def forward(self, hidden, position_offset=0, cache=None,
@@ -203,16 +238,40 @@ class LlamaAttention(Layer):
                 scale=1.0 / math.sqrt(self.head_dim), causal=True)
             out = out.reshape([b, s, self.num_heads, self.head_dim])
         elif cache is not None:
-            if self.config.sliding_window:
-                raise NotImplementedError(
-                    "sliding_window + KV-cache decode needs a rolling "
-                    "cache buffer — not implemented; decode without the "
-                    "window or use the training/prefill path")
             # incremental decode: cache is (k_cache, v_cache) Tensors laid
-            # out (B, S_max, HK, D) with valid length = position_offset + s
-            k, v, cache = self._update_cache(k, v, cache, position_offset)
-            out = self._decode_attend(q, k, v, position_offset + s)
+            # out (B, S_max, HK, D) with valid length = position_offset + s.
+            # Sliding-window models use the cache as a ROLLING buffer of
+            # length min(S_max, window): writes wrap (position % len) and
+            # attention covers the live slots — softmax is permutation-
+            # invariant over keys, so the wrapped order needs no
+            # unwrapping (allocate via init_caches, which clamps).
+            if self.config.sliding_window and s > 1:
+                # windowed prefill: attend the CALL'S OWN keys with the
+                # dense banded kernel (every query's band lies inside
+                # this chunk when offset==0); the rolling buffer is
+                # storage for the subsequent decode steps. Chunked
+                # prefill (offset>0) would need evicted keys back.
+                if position_offset != 0:
+                    raise NotImplementedError(
+                        "sliding_window + chunked prefill (cache with "
+                        "position_offset>0 and s>1) is not supported; "
+                        "prefill in one chunk, then decode token by "
+                        "token")
+                _, _, cache = self._update_cache(k, v, cache,
+                                                 position_offset)
+                out = F.sliding_window_attention(
+                    q, k, v, self.config.sliding_window)
+            else:
+                k, v, cache = self._update_cache(k, v, cache,
+                                                 position_offset)
+                out = self._decode_attend(q, k, v, position_offset + s)
         elif self.config.sliding_window:
+            if (self.config.context_parallel
+                    and mesh_state.mesh_axis_size("sep") > 1):
+                raise NotImplementedError(
+                    "sliding_window + context_parallel is not composed "
+                    "yet (shard-local bands would drop cross-shard "
+                    "in-window keys); disable one of the two")
             out = F.sliding_window_attention(
                 q, k, v, self.config.sliding_window)
         elif (self.config.context_parallel
@@ -239,9 +298,36 @@ class LlamaAttention(Layer):
 
     def _update_cache(self, k, v, cache, position_offset):
         import jax
+        import jax.numpy as jnp
 
         kc = ensure_tensor(cache[0])
         vc = ensure_tensor(cache[1])
+        cache_len = int(kc.shape[1])
+        s = int(k.shape[1])
+        if s > cache_len and not self.config.sliding_window:
+            # a non-windowed model overflowing its cache has no valid
+            # semantics — wrap-writes would permute slots the slot-index
+            # causal mask then misreads (silent causality violation)
+            raise ValueError(
+                f"KV cache length {cache_len} < {s} tokens written; "
+                f"allocate init_caches(max_len >= prompt + new tokens)")
+        if self.config.sliding_window:
+            # rolling buffer: wrap writes; if this call alone overflows
+            # the buffer only its LAST cache_len tokens matter (scatter
+            # with duplicate slots has no write order to rely on)
+            if s > cache_len:
+                k = k[:, s - cache_len:]
+                v = v[:, s - cache_len:]
+                position_offset = position_offset + (s - cache_len)
+                s = cache_len
+
+            def upd(c, n):
+                idx = (position_offset + jnp.arange(s)) % cache_len
+                return c.at[:, idx].set(n.astype(c.dtype))
+
+            new_kc = apply(upd, kc, k, op_name="kv_cache_update")
+            new_vc = apply(upd, vc, v, op_name="kv_cache_update")
+            return new_kc, new_vc, (new_kc, new_vc)
         new_kc = apply(lambda c, n: jax.lax.dynamic_update_slice_in_dim(
             c, n.astype(c.dtype), position_offset, axis=1), kc, k,
             op_name="kv_cache_update")
@@ -251,18 +337,31 @@ class LlamaAttention(Layer):
         return new_kc, new_vc, (new_kc, new_vc)
 
     def _decode_attend(self, q, k_cache, v_cache, valid_len):
-        """Single-step (or short-suffix) attention over the cache."""
+        """Single-step (or short-suffix) attention over the cache.
+        ``valid_len`` counts ABSOLUTE tokens so far; with a rolling
+        (sliding-window) buffer only ``min(valid_len, cache_len)`` slots
+        are live, and multi-token suffixes mask by each slot's
+        reconstructed absolute position."""
         import jax
         import jax.numpy as jnp
 
+        windowed = bool(self.config.sliding_window)
+
         def fn(qv, kc, vc):
             b = qv.shape[0]
-            if qv.shape[1] == 1 and jax.default_backend() == "tpu":
+            cache_len = kc.shape[1]
+            live = min(valid_len, cache_len) if windowed else valid_len
+            pallas_ok = (not windowed
+                         or cache_len <= int(self.config.sliding_window))
+            if qv.shape[1] == 1 and jax.default_backend() == "tpu" \
+                    and pallas_ok:
                 from ..ops.pallas.decode_attention import decode_attention
 
-                lens = jnp.full((b,), valid_len, jnp.int32)
+                # single query: it attends every live slot (the window
+                # IS the buffer — cache_len <= window checked above),
+                # wrapped order irrelevant to softmax
+                lens = jnp.full((b,), live, jnp.int32)
                 return decode_attention(qv, kc, vc, lens)
-            # prefill/suffix path: mask to the valid prefix
             rep = qv.shape[2] // kc.shape[2]
             kr = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
             vr = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
@@ -271,9 +370,18 @@ class LlamaAttention(Layer):
             logits = jnp.einsum(
                 "bqhd,bkhd->bhqk", qv.astype(jnp.float32),
                 kr.astype(jnp.float32)) * sc
-            q_pos = valid_len - sq + jnp.arange(sq)
-            k_pos = jnp.arange(sk)
-            mask = k_pos[None, :] <= q_pos[:, None]
+            q_pos = valid_len - sq + jnp.arange(sq)  # absolute
+            k_slot = jnp.arange(sk)
+            if windowed:
+                # slot j holds absolute position a(j) = the largest
+                # p < valid_len with p % cache_len == j
+                a = valid_len - 1 - ((valid_len - 1 - k_slot) % sk)
+                w = int(self.config.sliding_window)
+                mask = (a[None, :] <= q_pos[:, None]) \
+                    & (a[None, :] > q_pos[:, None] - w) \
+                    & (a[None, :] >= 0)
+            else:
+                mask = k_slot[None, :] <= q_pos[:, None]
             logits = jnp.where(mask[None, None], logits, -1e30)
             p = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", p, vr.astype(jnp.float32))
@@ -481,6 +589,9 @@ class LlamaForCausalLM(Layer):
         import paddle_tpu as paddle
 
         cfg = self.config
+        if cfg.sliding_window:
+            # rolling buffer: the cache never needs more than the window
+            max_len = min(max_len, cfg.sliding_window)
         caches = []
         for _ in range(cfg.num_hidden_layers):
             shape = [batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim]

@@ -75,6 +75,48 @@ def _run_full(qi, ki, block_q, block_k, causal, causal_offset, kv_len,
     return run, full
 
 
+def _kv_band_clamp(block_q, block_k, causal, causal_offset, window,
+                   kv_steps):
+    """Index-map clamp: re-point a dead kv tile at the nearest LIVE tile
+    for its q row — consecutive repeated indices elide the DMA (the
+    paged kernel's dead-step trick), so causal upper-triangle tiles and
+    window below-band tiles cost no HBM traffic, not just no compute."""
+    import jax.numpy as jnp
+
+    def clamp(qi, ki):
+        if not causal:
+            return ki
+        hi = jnp.minimum(kv_steps - 1,
+                         ((qi + 1) * block_q - 1 + causal_offset)
+                         // block_k)
+        lo = 0
+        if window is not None:
+            lo = jnp.maximum(
+                0, (qi * block_q + causal_offset - window + 1) // block_k)
+        return jnp.clip(ki, lo, hi)
+
+    return clamp
+
+
+def _q_band_clamp(block_q, block_k, causal, causal_offset, window, q_steps):
+    """Transpose of _kv_band_clamp for the dkv kernel's q-side fetches."""
+    import jax.numpy as jnp
+
+    def clamp(ki, qi):
+        if not causal:
+            return qi
+        lo = jnp.maximum(0, (ki * block_k - causal_offset) // block_q)
+        hi = q_steps - 1
+        if window is not None:
+            hi = jnp.minimum(
+                q_steps - 1,
+                ((ki + 1) * block_k - 1 + window - 1 - causal_offset)
+                // block_q)
+        return jnp.clip(qi, lo, hi)
+
+    return clamp
+
+
 def _mask_for_block(qi, ki, block_q, block_k, causal, causal_offset, kv_len,
                     window=None):
     """Boolean validity mask (BQ, BK) for one (q-block, kv-block) tile."""
@@ -178,15 +220,19 @@ def _flash_fwd(q, k, v, causal, causal_offset, kv_len, sm_scale,
         block_q=block_q, block_k=block_k, kv_steps=kv_steps,
         window=window,
     )
+    kvc = _kv_band_clamp(block_q, block_k, causal, causal_offset, window,
+                         kv_steps)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, q_steps, kv_steps),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+                         lambda b_, h_, qi, ki: (b_, h_ // group,
+                                                 kvc(qi, ki), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+                         lambda b_, h_, qi, ki: (b_, h_ // group,
+                                                 kvc(qi, ki), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
@@ -349,15 +395,21 @@ def _flash_bwd(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
                   sm_scale=sm_scale, block_q=block_q, block_k=block_k,
                   window=window)
 
+    kvc = _kv_band_clamp(block_q, block_k, causal, causal_offset, window,
+                         kv_steps)
+    qc = _q_band_clamp(block_q, block_k, causal, causal_offset, window,
+                       q_steps)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, kv_steps=kv_steps, **common),
         grid=(b, h, q_steps, kv_steps),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+                         lambda b_, h_, qi, ki: (b_, h_ // group,
+                                                 kvc(qi, ki), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+                         lambda b_, h_, qi, ki: (b_, h_ // group,
+                                                 kvc(qi, ki), 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
@@ -374,12 +426,16 @@ def _flash_bwd(causal, causal_offset, kv_len, sm_scale, block_q, block_k,
         functools.partial(_bwd_dkv_kernel, q_steps=q_steps, **common),
         grid=(b, h, kv_steps, q_steps),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ki, qi: (b_, h_, qc(ki, qi), 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ki, qi: (b_, h_, qc(ki, qi), 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, ki, qi: (b_, h_, qc(ki, qi), 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, ki, qi: (b_, h_, qc(ki, qi), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),

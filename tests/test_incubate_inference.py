@@ -563,3 +563,37 @@ def test_fused_multi_transformer_weight_only_int8_parity():
     out_f, _ = fmt_f(x, caches=cf, time_step=0)
     diff = np.abs(np.asarray(out_q._value) - np.asarray(out_f._value))
     assert 0 < diff.max() < 0.1
+
+
+def test_sliding_window_rolling_cache_decode():
+    """Round-5: windowed models decode against a ROLLING KV buffer of
+    window length. Oracle: on-device greedy decode == step-by-step full
+    forwards through the same model (whose dense path uses banded
+    sliding-window attention), across the point where the buffer wraps.
+    Also: init_caches clamps to the window."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import greedy_search, generate_on_device
+
+    paddle.seed(0)
+    w = 8
+    m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False,
+                                          sliding_window=w))
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(0, 128, (2, 10)))
+    new = 7  # crosses the wrap point (10 prompt > window 8 already)
+
+    # dense reference: full forward each step; banded attention inside
+    cur = ids.numpy()
+    for _ in range(new):
+        logits = m(paddle.to_tensor(cur))
+        cur = np.concatenate(
+            [cur, logits.numpy()[:, -1].argmax(-1)[:, None]], axis=1)
+
+    out = generate_on_device(m, ids, max_new_tokens=new)
+    assert (out.numpy() == cur).all(), (out.numpy(), cur)
+
+    host = greedy_search(m, ids, max_new_tokens=new)
+    assert (host.numpy() == cur).all()
+
+    caches = m.init_caches(2, 64)
+    assert caches[0][0].shape[1] == w  # clamped to the window

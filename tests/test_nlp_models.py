@@ -268,3 +268,48 @@ def test_gpt_recompute_matches_plain():
                                    err_msg=gran)
     with pytest.raises(ValueError, match="recompute_granularity"):
         loss_with(True, "core_attn")
+
+
+def test_mistral_qwen2_style_configs():
+    """Round-5 model-family knobs on the llama stack: Mistral = GQA +
+    sliding window (window genuinely cuts attention), Qwen2 =
+    attention_bias (q/k/v biases exist, train, and change outputs)."""
+    paddle.seed(0)
+    cfg_m = LlamaConfig.tiny(tensor_parallel=False, sliding_window=8)
+    assert LlamaConfig.mistral_7b().sliding_window == 4096
+    assert LlamaConfig.qwen2_7b().attention_bias is True
+
+    m = LlamaForCausalLM(cfg_m)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(0, 128, (1, 24)))
+    out = m(ids)
+    assert np.isfinite(out.numpy()).all()
+
+    # attention_bias: biases exist on q/k/v (not o), and a train step
+    # moves them
+    paddle.seed(0)
+    cfg_q = LlamaConfig.tiny(tensor_parallel=False, attention_bias=True)
+    q = LlamaForCausalLM(cfg_q)
+    attn = q.llama.layers[0].self_attn
+    assert attn.q_proj.bias is not None
+    assert attn.k_proj.bias is not None
+    assert attn.v_proj.bias is not None
+    assert attn.o_proj.bias is None
+    names = [n for n, _ in q.named_parameters()]
+    assert any("q_proj.bias" in n for n in names)
+
+    from paddle_tpu.nlp import LlamaPretrainingCriterion
+
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=q.parameters())
+    b0 = attn.q_proj.bias.numpy().copy()
+    loss = crit(q(ids), ids)
+    loss.backward()
+    opt.step()
+    assert np.abs(attn.q_proj.bias.numpy() - b0).max() > 0
+
+    # after the update the biases are nonzero → outputs differ from a
+    # freshly-built no-bias model with the same seed
+    paddle.seed(0)
+    nb = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+    assert np.abs(q(ids).numpy() - nb(ids).numpy()).max() > 1e-6

@@ -416,6 +416,9 @@ def test_llama_sliding_window_config():
                                atol=1e-5)
     assert np.abs(out_w[:, -1] - out_f[:, -1]).max() > 1e-4
 
-    with pytest.raises(NotImplementedError, match="rolling"):
-        caches = m.init_caches(2, 16)
-        m(paddle.to_tensor(ids_np[:, :4]), caches=caches)
+    # cache decode now rides a rolling buffer (round-5); the raising
+    # combo is CHUNKED prefill (cache, offset>0, s>1)
+    caches = m.init_caches(2, 16)
+    with pytest.raises(NotImplementedError, match="chunked"):
+        m(paddle.to_tensor(ids_np[:, :4]), caches=caches,
+          position_offset=4)
