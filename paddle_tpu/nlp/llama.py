@@ -77,8 +77,8 @@ class LlamaConfig:
         # query attends to the last `sliding_window` tokens. Training
         # and prefill use the banded flash kernel; decode runs against
         # a ROLLING KV buffer of window length (init_caches clamps).
-        # Chunked prefill (cache, offset>0, s>1) raises; packed
-        # cu_seqlens + window raises (no band varlen tiles yet).
+        # Packed cu_seqlens applies the band per segment; chunked
+        # prefill (cache, offset>0, s>1) and context_parallel raise.
         self.sliding_window = sliding_window
         # Qwen2-style: q/k/v projections carry biases (o_proj does not)
         self.attention_bias = attention_bias
@@ -220,22 +220,18 @@ class LlamaAttention(Layer):
                       op_name="rope_k")
 
         if cu_seqlens is not None:
-            if self.config.sliding_window:
-                raise NotImplementedError(
-                    "sliding_window + packed cu_seqlens training is not "
-                    "implemented (the varlen kernel has no band tiles "
-                    "yet); train dense with the window or packed "
-                    "without it")
             # packed ragged sequences, (B=1, T) layout: the Pallas varlen
             # kernel skips dead cross-segment tiles AND their KV DMA
-            # (ops/pallas/varlen_flash_attention.py)
+            # (ops/pallas/varlen_flash_attention.py); sliding-window
+            # models apply the band PER SEGMENT (round 5)
             t = b * s
             out, _ = F.flash_attn_unpadded(
                 q.reshape([t, self.num_heads, self.head_dim]),
                 k.reshape([t, self.num_kv_heads, self.head_dim]),
                 v.reshape([t, self.num_kv_heads, self.head_dim]),
                 cu_seqlens, cu_seqlens, s, s,
-                scale=1.0 / math.sqrt(self.head_dim), causal=True)
+                scale=1.0 / math.sqrt(self.head_dim), causal=True,
+                window_size=self.config.sliding_window)
             out = out.reshape([b, s, self.num_heads, self.head_dim])
         elif cache is not None:
             # incremental decode: cache is (k_cache, v_cache) Tensors laid

@@ -155,7 +155,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 
 def _xla_varlen_attention(q, k, v, cu_q, cu_k, scale, causal,
-                          dropout_p=0.0, key=None):
+                          dropout_p=0.0, key=None, window=None):
     """Segment-masked XLA reference for packed varlen attention (O(T^2)
     memory) — the numeric oracle for the Pallas kernel and the off-TPU /
     dropout path. Supports GQA and unequal q/kv lengths (bottom-right
@@ -179,6 +179,8 @@ def _xla_varlen_attention(q, k, v, cu_q, cu_k, scale, causal,
         rel_q = pos_q - cu_q[seg_q] + lk - lq
         rel_k = pos_k - cu_k[seg_k]
         mask = mask & (rel_q[:, None] >= rel_k[None, :])
+        if window is not None:
+            mask = mask & (rel_k[None, :] > rel_q[:, None] - window)
     logits = jnp.where(mask[None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     # fully-masked rows (empty segments) produce nan; zero them
@@ -194,7 +196,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
-                        name=None):
+                        window_size=None, name=None):
     """Varlen flash attention: (total_tokens, H, D) + cumulative seqlens.
 
     On TPU this runs the blockwise Pallas varlen kernel
@@ -205,6 +207,16 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     query, key_, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     cu_q = ensure_tensor(cu_seqlens_q)
     cu_k = ensure_tensor(cu_seqlens_k)
+    # validated HERE so the Pallas and XLA backends agree (the XLA
+    # band mask is nested under causal and would silently ignore it)
+    if window_size is not None:
+        if not causal:
+            raise ValueError(
+                "flash_attn_unpadded: window_size requires causal=True")
+        if window_size < 1:
+            raise ValueError(
+                f"flash_attn_unpadded: window_size must be >= 1, got "
+                f"{window_size}")
 
     flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
     use_pallas = (
@@ -215,7 +227,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     if use_pallas:
         out = apply(
             lambda q, k, v, cq, ck: _pallas_varlen_flash(
-                q, k, v, cq, ck, causal=causal, sm_scale=scale),
+                q, k, v, cq, ck, causal=causal, sm_scale=scale,
+                window_size=window_size),
             query, key_, value, cu_q, cu_k, op_name="flash_attn_unpadded",
         )
         return out, None
@@ -228,7 +241,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     out = apply(
         lambda q, k, v, cq, ck: _xla_varlen_attention(
             q, k, v, cq, ck, scale, causal,
-            dropout_p=dropout if training else 0.0, key=rng_key),
+            dropout_p=dropout if training else 0.0, key=rng_key,
+            window=window_size),
         query, key_, value, cu_q, cu_k, op_name="flash_attn_unpadded",
     )
     return out, None

@@ -61,7 +61,8 @@ def _default_blocks(head_dim):
     return 256, 256
 
 
-def _partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k):
+def _partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k,
+                  window=None):
     """(BQ, BK) validity mask for a boundary tile."""
     reps = block_k // LANES
     qs_t = jnp.tile(qs_ref[...], (1, reps))   # (BQ, BK)
@@ -69,6 +70,9 @@ def _partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k):
     if causal:
         qr_t = jnp.tile(qr_ref[...], (1, reps))
         mask = mask & (qr_t >= kr_ref[0:1, :])
+        if window is not None:
+            # sliding-window band in per-segment relative coordinates
+            mask = mask & (kr_ref[0:1, :] > qr_t - window)
     return mask
 
 
@@ -78,7 +82,7 @@ def _partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k):
 def _fwd_kernel(run_ref, full_ref, q_ref, k_ref, v_ref,
                 qs_ref, qr_ref, ks_ref, kr_ref,
                 o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                causal, sm_scale, block_k, kv_steps):
+                causal, sm_scale, block_k, kv_steps, window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -122,7 +126,7 @@ def _fwd_kernel(run_ref, full_ref, q_ref, k_ref, v_ref,
 
     @pl.when(run & ~full)
     def _boundary():
-        mask = _partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k)
+        mask = _partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k, window)
         accumulate(scores(), mask)
 
     @pl.when(ki == kv_steps - 1)
@@ -133,7 +137,7 @@ def _fwd_kernel(run_ref, full_ref, q_ref, k_ref, v_ref,
 
 
 def _varlen_fwd(q, k, v, qs, qr, ks, kr, run_map, full_map,
-                causal, sm_scale, block_q, block_k):
+                causal, sm_scale, block_q, block_k, window=None):
     """q: (H, Tq, D); k/v: (HK, Tk, D); aux pre-padded to block multiples."""
     h, tq, d = q.shape
     hk, tk = k.shape[0], k.shape[1]
@@ -151,7 +155,7 @@ def _varlen_fwd(q, k, v, qs, qr, ks, kr, run_map, full_map,
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale,
-        block_k=block_k, kv_steps=kv_steps,
+        block_k=block_k, kv_steps=kv_steps, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -196,7 +200,8 @@ def _varlen_fwd(q, k, v, qs, qr, ks, kr, run_map, full_map,
 # --------------------------------------------------------------------------
 def _bwd_dq_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, qs_ref, qr_ref, ks_ref, kr_ref,
-                   dq_ref, dq_scr, *, causal, sm_scale, block_k, kv_steps):
+                   dq_ref, dq_scr, *, causal, sm_scale, block_k, kv_steps,
+                   window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -234,7 +239,7 @@ def _bwd_dq_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(run & ~full)
     def _boundary():
-        body(_partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k))
+        body(_partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k, window))
 
     @pl.when(ki == kv_steps - 1)
     def _store():
@@ -247,7 +252,7 @@ def _bwd_dq_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _bwd_dkv_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, qs_ref, qr_ref, ks_ref, kr_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    causal, sm_scale, block_k, q_steps):
+                    causal, sm_scale, block_k, q_steps, window=None):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -290,7 +295,7 @@ def _bwd_dkv_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(run & ~full)
     def _boundary():
-        body(_partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k))
+        body(_partial_mask(qs_ref, qr_ref, ks_ref, kr_ref, causal, block_k, window))
 
     @pl.when(qi == q_steps - 1)
     def _store():
@@ -298,7 +303,7 @@ def _bwd_dkv_kernel(run_ref, full_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _varlen_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+def _varlen_bwd(causal, sm_scale, block_q, block_k, window, residuals, g):
     q, k, v, qs, qr, ks, kr, run_map, full_map, out, lse = residuals
     do = g[0] if isinstance(g, tuple) else g
     h, tq, d = q.shape
@@ -318,7 +323,8 @@ def _varlen_bwd(causal, sm_scale, block_q, block_k, residuals, g):
         keepdims=True,
     )
 
-    common = dict(causal=causal, sm_scale=sm_scale, block_k=block_k)
+    common = dict(causal=causal, sm_scale=sm_scale, block_k=block_k,
+                  window=window)
 
     def kv_idx(h_, qi, ki, run_ref, full_ref):
         return (h_ // group, jax.lax.select(run_ref[qi, ki] == 1, ki, 0), 0)
@@ -414,23 +420,24 @@ def _varlen_bwd(causal, sm_scale, block_q, block_k, residuals, g):
     return dq, dk, dv, None, None, None, None, None, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
 def _varlen_htd(q, k, v, qs, qr, ks, kr, run_map, full_map,
-                causal, sm_scale, block_q, block_k):
+                causal, sm_scale, block_q, block_k, window=None):
     out, _ = _varlen_fwd(q, k, v, qs, qr, ks, kr, run_map, full_map,
-                         causal, sm_scale, block_q, block_k)
+                         causal, sm_scale, block_q, block_k, window)
     return out
 
 
 def _fwd_rule(q, k, v, qs, qr, ks, kr, run_map, full_map,
-              causal, sm_scale, block_q, block_k):
+              causal, sm_scale, block_q, block_k, window=None):
     out, lse = _varlen_fwd(q, k, v, qs, qr, ks, kr, run_map, full_map,
-                           causal, sm_scale, block_q, block_k)
+                           causal, sm_scale, block_q, block_k, window)
     return out, (q, k, v, qs, qr, ks, kr, run_map, full_map, out, lse)
 
 
-def _bwd_rule(causal, sm_scale, block_q, block_k, residuals, g):
-    return _varlen_bwd(causal, sm_scale, block_q, block_k, residuals, g)
+def _bwd_rule(causal, sm_scale, block_q, block_k, window, residuals, g):
+    return _varlen_bwd(causal, sm_scale, block_q, block_k, window,
+                       residuals, g)
 
 
 _varlen_htd.defvjp(_fwd_rule, _bwd_rule)
@@ -465,7 +472,7 @@ def _block_stats(x, steps, block):
     return xb.min(axis=1), xb.max(axis=1)
 
 
-def _tile_maps(seg_q, rel_q, seg_k, rel_k, bq, bk, causal):
+def _tile_maps(seg_q, rel_q, seg_k, rel_k, bq, bk, causal, window=None):
     """(q_steps, kv_steps) int32 run/full predicates from per-token aux."""
     q_steps = seg_q.shape[0] // bq
     kv_steps = seg_k.shape[0] // bk
@@ -487,18 +494,30 @@ def _tile_maps(seg_q, rel_q, seg_k, rel_k, bq, bk, causal):
     if causal:
         run = run & (kr_lo[None, :] <= qr_hi[:, None])
         full = full & (qr_lo[:, None] >= kr_hi[None, :])
+        if window is not None:
+            # band lower edge (per-segment relative coords): some pair
+            # within window → run; every pair within window → full
+            run = run & (kr_hi[None, :] > qr_lo[:, None] - window)
+            full = full & (kr_lo[None, :] > qr_hi[:, None] - window)
     return run.astype(jnp.int32), full.astype(jnp.int32)
 
 
 def varlen_flash_attention(q, k, v, cu_seqlens_q, cu_seqlens_k,
                            causal=False, sm_scale=None,
-                           block_q=None, block_k=None):
+                           block_q=None, block_k=None, window_size=None):
     """Packed varlen flash attention.
 
     q: (total_q, H, D); k/v: (total_k, HK, D); cu_seqlens_*: (B+1,) int32
     prefix sums. Tokens of sequence i occupy rows cu[i]:cu[i+1]; attention
     never crosses sequence boundaries. Returns (total_q, H, D).
+    ``window_size`` (causal only) applies the Mistral sliding-window band
+    PER SEGMENT — band-exterior tiles are dead tiles (no compute, no KV
+    DMA), like cross-segment tiles.
     """
+    if window_size is not None and not causal:
+        raise ValueError("window_size requires causal=True")
+    if window_size is not None and window_size < 1:
+        raise ValueError(f"window_size must be >= 1, got {window_size}")
     tq, h, d = q.shape
     tk, hk = k.shape[0], k.shape[1]
     if h % hk != 0:
@@ -521,7 +540,9 @@ def varlen_flash_attention(q, k, v, cu_seqlens_q, cu_seqlens_k,
     seg_q, rel_q = _aux_arrays(cu_q, tq + pad_q, _Q_PAD_SEG, _REL_LO,
                                cu_other=cu_k)
     seg_k, rel_k = _aux_arrays(cu_k, tk + pad_k, _K_PAD_SEG, _REL_HI)
-    run_map, full_map = _tile_maps(seg_q, rel_q, seg_k, rel_k, bq, bk, causal)
+    win = None if window_size is None else int(window_size)
+    run_map, full_map = _tile_maps(seg_q, rel_q, seg_k, rel_k, bq, bk,
+                                   causal, win)
 
     qs = jax.lax.broadcast_in_dim(seg_q, (tq + pad_q, LANES), (0,))
     qr = jax.lax.broadcast_in_dim(rel_q, (tq + pad_q, LANES), (0,))
@@ -538,7 +559,7 @@ def varlen_flash_attention(q, k, v, cu_seqlens_q, cu_seqlens_k,
         vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
 
     out = _varlen_htd(qt, kt, vt, qs, qr, ks, kr, run_map, full_map,
-                      causal, sm_scale, bq, bk)
+                      causal, sm_scale, bq, bk, win)
     if pad_q:
         out = out[:, :tq]
     return jnp.moveaxis(out, 0, 1)

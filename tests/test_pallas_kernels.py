@@ -327,9 +327,11 @@ def test_flash_attn_unpadded_dispatches_to_pallas(monkeypatch):
     calls = {}
     real = attn_mod._pallas_varlen_flash
 
-    def spy(q, k, v, cq, ck, causal=False, sm_scale=None):
+    def spy(q, k, v, cq, ck, causal=False, sm_scale=None,
+            window_size=None):
         calls["hit"] = True
-        return real(q, k, v, cq, ck, causal=causal, sm_scale=sm_scale)
+        return real(q, k, v, cq, ck, causal=causal, sm_scale=sm_scale,
+                    window_size=window_size)
 
     monkeypatch.setattr(attn_mod, "_pallas_varlen_flash", spy)
     paddle.set_flags({"FLAGS_pallas_force": True})
@@ -422,3 +424,79 @@ def test_llama_sliding_window_config():
     with pytest.raises(NotImplementedError, match="chunked"):
         m(paddle.to_tensor(ids_np[:, :4]), caches=caches,
           position_offset=4)
+
+
+def test_varlen_sliding_window_matches_reference():
+    """Round-5: the varlen kernel's per-segment sliding-window band.
+    Oracle: banded masked XLA attention; fwd AND grads, ragged segments
+    longer and shorter than the window."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.varlen_flash_attention import (
+        varlen_flash_attention,
+    )
+    from paddle_tpu.nn.functional.attention import _xla_varlen_attention
+
+    rng = np.random.RandomState(6)
+    lens = [50, 7, 90, 30]
+    T = sum(lens)
+    cu = jnp.asarray(np.concatenate([[0], np.cumsum(lens)]).astype(np.int32))
+    h, hk, d, w = 4, 2, 64, 16
+    q = jnp.asarray(rng.randn(T, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(T, hk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(T, hk, d), jnp.float32)
+    sc = d ** -0.5
+
+    out = varlen_flash_attention(q, k, v, cu, cu, causal=True,
+                                 window_size=w, block_q=128, block_k=128)
+    ref = _xla_varlen_attention(q, k, v, cu, cu, sc, True, window=w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # the band must genuinely cut (segment 2 is longer than the window)
+    full = varlen_flash_attention(q, k, v, cu, cu, causal=True,
+                                  block_q=128, block_k=128)
+    assert np.abs(np.asarray(out) - np.asarray(full)).max() > 1e-3
+
+    def loss_f(q, k, v):
+        return jnp.sum(varlen_flash_attention(
+            q, k, v, cu, cu, causal=True, window_size=w,
+            block_q=128, block_k=128) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(_xla_varlen_attention(
+            q, k, v, cu, cu, sc, True, window=w) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError, match="causal"):
+        varlen_flash_attention(q, k, v, cu, cu, causal=False,
+                               window_size=w)
+
+
+def test_llama_packed_sliding_window_matches_per_sequence():
+    """Packed + sliding_window: each packed segment's logits must equal
+    that sequence forwarded ALONE through the same windowed model."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False,
+                                          sliding_window=6))
+    m.eval()
+    lens = [9, 4, 14]
+    rng = np.random.RandomState(7)
+    segs = [rng.randint(0, 128, (ln,)) for ln in lens]
+    packed = np.concatenate(segs)[None, :]
+    cu = paddle.to_tensor(
+        np.concatenate([[0], np.cumsum(lens)]).astype(np.int32))
+    out = m(paddle.to_tensor(packed), cu_seqlens=cu).numpy()[0]
+    ofs = 0
+    for seg in segs:
+        alone = m(paddle.to_tensor(seg[None, :])).numpy()[0]
+        np.testing.assert_allclose(out[ofs:ofs + len(seg)], alone,
+                                   rtol=2e-4, atol=2e-4)
+        ofs += len(seg)
