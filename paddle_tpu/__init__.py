@@ -120,6 +120,7 @@ from . import nlp  # noqa: E402
 from . import profiler  # noqa: E402
 from . import fft  # noqa: E402
 from . import quantization  # noqa: E402
+from . import peft  # noqa: E402
 from . import sparse  # noqa: E402
 from . import device  # noqa: E402
 from . import visualdl  # noqa: E402

@@ -231,7 +231,7 @@ class LlamaAttention(Layer):
                 v.reshape([t, self.num_kv_heads, self.head_dim]),
                 cu_seqlens, cu_seqlens, s, s,
                 scale=1.0 / math.sqrt(self.head_dim), causal=True,
-                window_size=self.config.sliding_window)
+                window_size=self.config.sliding_window or None)
             out = out.reshape([b, s, self.num_heads, self.head_dim])
         elif cache is not None:
             # incremental decode: cache is (k_cache, v_cache) Tensors laid

@@ -1,0 +1,19 @@
+"""paddle.peft-style parameter-efficient fine-tuning (reference:
+paddlenlp.peft.lora — unverified, SURVEY.md §0).
+
+TPU-native notes: LoRA is pure layer surgery — the frozen base weight
+stays on whatever NamedSharding the fleet layers gave it, the low-rank
+A/B factors are tiny and replicate, and the whole delta rides one XLA
+fusion (x @ A @ B * scaling added to the base matmul's output). Under
+`JittedTrainStep` the frozen params still travel as inputs; only the
+LoRA params receive gradients (stop_gradient on everything else).
+"""
+from .lora import (  # noqa: F401
+    LoRAConfig, LoRALinear, LoRAModel, get_lora_model,
+    mark_only_lora_as_trainable, lora_state_dict,
+)
+
+__all__ = [
+    "LoRAConfig", "LoRALinear", "LoRAModel", "get_lora_model",
+    "mark_only_lora_as_trainable", "lora_state_dict",
+]

@@ -1,0 +1,219 @@
+"""LoRA — low-rank adaptation layers (reference: paddlenlp/peft/lora/
+lora_layers.py + lora_model.py — unverified, SURVEY.md §0).
+
+``y = x @ W + b + (x @ A) @ B * (alpha / r)`` with W frozen; A is
+Gaussian-initialized, B zero-initialized so the adapted model starts
+EXACTLY equal to the base model. ``merge()`` folds the delta into W for
+zero-overhead inference; ``unmerge()`` restores it.
+"""
+from __future__ import annotations
+
+import re
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn import functional as F
+from ..nn import initializer as I
+
+__all__ = [
+    "LoRAConfig", "LoRALinear", "LoRAModel", "get_lora_model",
+    "mark_only_lora_as_trainable", "lora_state_dict",
+]
+
+
+class LoRAConfig:
+    """Mirrors the reference's LoRAConfig fields that matter here.
+
+    Args:
+        r: rank of the update matrices.
+        lora_alpha: scaling numerator (delta is scaled by alpha / r).
+        lora_dropout: dropout on the LoRA input path (train only).
+        target_modules: list of regex patterns matched against sublayer
+            NAMES (e.g. ``[".*q_proj", ".*v_proj"]``); every matching
+            ``Linear``-like layer is wrapped.
+        trainable_bias: also leave biases of wrapped layers trainable.
+    """
+
+    def __init__(self, r=8, lora_alpha=16, lora_dropout=0.0,
+                 target_modules=(".*q_proj", ".*v_proj"),
+                 trainable_bias=False):
+        if r < 1:
+            raise ValueError(f"LoRA rank must be >= 1, got {r}")
+        self.r = int(r)
+        self.lora_alpha = float(lora_alpha)
+        self.lora_dropout = float(lora_dropout)
+        self.target_modules = list(target_modules)
+        self.trainable_bias = bool(trainable_bias)
+
+
+class LoRALinear(Layer):
+    """A Linear (or fleet Column/RowParallelLinear) wrapped with a
+    low-rank delta. The base layer keeps its own (possibly mp-sharded)
+    weight, frozen; A/B are small replicated factors."""
+
+    def __init__(self, base, r, lora_alpha, lora_dropout=0.0):
+        super().__init__()
+        w = base.weight
+        in_features, out_features = int(w.shape[0]), int(w.shape[1])
+        self.base = base
+        self.r = int(r)
+        self.scaling = float(lora_alpha) / float(r)
+        self.lora_dropout = float(lora_dropout)
+        # reference init: A ~ N(0, 1/r) (kaiming-ish), B = 0 → the
+        # adapted forward starts bit-equal to the base forward
+        self.lora_A = self.create_parameter(
+            (in_features, self.r),
+            default_initializer=I.Normal(std=1.0 / self.r))
+        self.lora_B = self.create_parameter(
+            (self.r, out_features), default_initializer=I.Constant(0.0))
+        self._merged = False
+        base.weight.stop_gradient = True
+        if getattr(base, "bias", None) is not None:
+            base.bias.stop_gradient = True
+
+    def forward(self, x):
+        out = self.base(x)
+        if self._merged:
+            return out
+        h = x
+        if self.lora_dropout and self.training:
+            h = F.dropout(h, p=self.lora_dropout, training=True)
+        delta = F.linear(F.linear(h, self.lora_A), self.lora_B)
+        return out + delta * self.scaling
+
+    def merge(self):
+        """Fold A@B*scaling into the frozen base weight (inference)."""
+        if self._merged:
+            return self
+        w = self.base.weight
+        w._value = (w._value
+                    + (self.lora_A._value @ self.lora_B._value
+                       * self.scaling).astype(w._value.dtype))
+        self._merged = True
+        return self
+
+    def unmerge(self):
+        if not self._merged:
+            return self
+        w = self.base.weight
+        w._value = (w._value
+                    - (self.lora_A._value @ self.lora_B._value
+                       * self.scaling).astype(w._value.dtype))
+        self._merged = False
+        return self
+
+    def extra_repr(self):
+        return f"r={self.r}, scaling={self.scaling}, merged={self._merged}"
+
+
+def _is_linear_like(layer):
+    from ..distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear,
+    )
+
+    return isinstance(layer, (Linear, ColumnParallelLinear,
+                              RowParallelLinear)) and \
+        getattr(layer, "weight", None) is not None
+
+
+def _wrap_targets(model, config):
+    pats = [re.compile(p) for p in config.target_modules]
+    wrapped = []
+
+    def visit(layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if any(p.fullmatch(full) or p.fullmatch(name) for p in pats) \
+                    and _is_linear_like(sub):
+                lora = LoRALinear(sub, config.r, config.lora_alpha,
+                                  config.lora_dropout)
+                layer._sub_layers[name] = lora
+                wrapped.append(full)
+            else:
+                visit(sub, full)
+
+    visit(model, "")
+    if not wrapped:
+        raise ValueError(
+            f"LoRA target_modules {config.target_modules} matched no "
+            f"Linear-like sublayer — check the patterns against "
+            f"named_sublayers()")
+    return wrapped
+
+
+def mark_only_lora_as_trainable(model, trainable_bias=False):
+    """Freeze every param except lora_A/lora_B; with ``trainable_bias``
+    the biases of WRAPPED layers (the LoRALinear bases) stay trainable
+    too — not every bias model-wide, and the adapter state dict must
+    then include them (see lora_state_dict)."""
+    for name, p in model.named_parameters():
+        is_lora = "lora_A" in name or "lora_B" in name
+        is_wrapped_bias = (trainable_bias and name.endswith(".bias")
+                           and ".base." in name)
+        p.stop_gradient = not (is_lora or is_wrapped_bias)
+    return model
+
+
+def lora_state_dict(model):
+    """The adapter artifact (reference: lora_model_state.pdparams):
+    lora_A/lora_B plus any TRAINABLE wrapped-layer bias (the
+    trainable_bias=True case) — everything a reload onto a fresh base
+    needs to reproduce the trained model."""
+    out = {}
+    for name, p in model.state_dict().items():
+        if "lora_A" in name or "lora_B" in name:
+            out[name] = p
+    for name, p in model.named_parameters():
+        if (name.endswith(".bias") and ".base." in name
+                and not p.stop_gradient):
+            out[name] = p
+    return out
+
+
+class LoRAModel(Layer):
+    """Wrapper mirroring paddlenlp.peft.LoRAModel: wraps target modules
+    in-place, freezes the rest, and forwards transparently."""
+
+    def __init__(self, model, lora_config):
+        super().__init__()
+        self.lora_config = lora_config
+        self.wrapped_names = _wrap_targets(model, lora_config)
+        self.add_sublayer("model", model)
+        mark_only_lora_as_trainable(self,
+                                    lora_config.trainable_bias)
+
+    def forward(self, *args, **kwargs):
+        return self.model(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(super().__getattr__("model"), name)
+
+    def merge(self):
+        for layer in self._lora_layers():
+            layer.merge()
+        return self
+
+    def unmerge(self):
+        for layer in self._lora_layers():
+            layer.unmerge()
+        return self
+
+    def _lora_layers(self):
+        out = []
+
+        def visit(layer):
+            for sub in layer._sub_layers.values():
+                if isinstance(sub, LoRALinear):
+                    out.append(sub)
+                visit(sub)
+
+        visit(self)
+        return out
+
+
+def get_lora_model(model, lora_config):
+    """Reference entry point: paddlenlp.peft.get_lora_model."""
+    return LoRAModel(model, lora_config)

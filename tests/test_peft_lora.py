@@ -1,0 +1,159 @@
+"""LoRA (paddle.peft): wrap/freeze/train/merge semantics on plain and
+fleet-TP models (reference: paddlenlp.peft.lora — unverified, SURVEY
+§0)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.peft import (
+    LoRAConfig, LoRALinear, get_lora_model, lora_state_dict,
+)
+from paddle_tpu.parallel import mesh as mesh_state
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    mesh_state.set_mesh(None)
+
+
+def _llama():
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+
+
+def test_lora_starts_equal_and_trains_only_adapters():
+    m = _llama()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(0, 128, (2, 12)))
+    base_out = m(ids).numpy()
+
+    lora = get_lora_model(m, LoRAConfig(r=4, lora_alpha=8))
+    # B zero-init → adapted == base at step 0
+    np.testing.assert_allclose(lora(ids).numpy(), base_out,
+                               rtol=1e-6, atol=1e-6)
+
+    trainable = [n for n, p in lora.named_parameters()
+                 if not p.stop_gradient]
+    assert trainable and all("lora_" in n for n in trainable)
+    n_train = sum(int(np.prod(p.shape)) for _, p in
+                  lora.named_parameters() if not p.stop_gradient)
+    n_total = sum(int(np.prod(p.shape)) for _, p in
+                  lora.named_parameters())
+    assert n_train < n_total * 0.1  # genuinely parameter-efficient
+
+    from paddle_tpu.nlp import LlamaPretrainingCriterion
+
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(
+        1e-2, parameters=[p for _, p in lora.named_parameters()
+                          if not p.stop_gradient])
+    frozen_before = {n: p.numpy().copy()
+                     for n, p in lora.named_parameters() if p.stop_gradient}
+    for _ in range(2):
+        loss = crit(lora(ids), ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # adapters moved, base stayed frozen
+    changed = lora(ids).numpy()
+    assert np.abs(changed - base_out).max() > 1e-5
+    for n, p in lora.named_parameters():
+        if p.stop_gradient:
+            np.testing.assert_allclose(p.numpy(), frozen_before[n],
+                                       rtol=0, atol=0, err_msg=n)
+
+    # the adapter artifact holds only lora tensors
+    sd = lora_state_dict(lora)
+    assert sd and all("lora_" in k for k in sd)
+
+    # merge folds the delta into the frozen weight: same outputs, no
+    # per-step delta matmuls; unmerge restores the base exactly
+    merged_out = lora.merge()(ids).numpy()
+    np.testing.assert_allclose(merged_out, changed, rtol=2e-5, atol=2e-5)
+    lora.unmerge()
+    np.testing.assert_allclose(lora(ids).numpy(), changed,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_lora_jitted_train_step():
+    """LoRA under the fused JittedTrainStep: only adapters update."""
+    from paddle_tpu.nlp import LlamaPretrainingCriterion
+    from paddle_tpu.jit.train import JittedTrainStep
+
+    m = _llama()
+    lora = get_lora_model(m, LoRAConfig(r=4, lora_alpha=8))
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(
+        1e-2, parameters=[p for _, p in lora.named_parameters()
+                          if not p.stop_gradient])
+    step = JittedTrainStep(lora, lambda o, l: crit(o, l), opt)
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(0, 128, (2, 16)))
+    l0 = float(step(ids, ids))
+    l1 = float(step(ids, ids))
+    assert np.isfinite([l0, l1]).all()
+
+
+def test_lora_on_tp_model_matches_serial():
+    """LoRA wraps the fleet mp q_proj/v_proj; parallel == serial."""
+    from paddle_tpu.nlp import (
+        LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+    )
+    from paddle_tpu.distributed import fleet
+
+    ids_np = np.random.RandomState(2).randint(0, 128, (4, 8))
+
+    def run(parallel):
+        mesh_state.set_mesh(None)
+        if parallel:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {
+                "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                "sharding_degree": 1,
+            }
+            fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=True))
+        lora = get_lora_model(m, LoRAConfig(r=4, lora_alpha=8))
+        crit = LlamaPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(
+            1e-2, parameters=[p for _, p in lora.named_parameters()
+                              if not p.stop_gradient])
+        ids = paddle.to_tensor(ids_np)
+        losses = []
+        for _ in range(2):
+            loss = crit(lora(ids), ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        mesh_state.set_mesh(None)
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_lora_bad_targets_raise():
+    m = _llama()
+    with pytest.raises(ValueError, match="matched no"):
+        get_lora_model(m, LoRAConfig(target_modules=[".*nonexistent"]))
+
+
+def test_lora_trainable_bias_scoped_to_wrapped_layers():
+    """trainable_bias unfreezes ONLY wrapped-layer biases, and the
+    adapter state dict carries them (a reload must reproduce the
+    trained model)."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False,
+                                          attention_bias=True))
+    lora = get_lora_model(m, LoRAConfig(r=2, trainable_bias=True))
+    for n, p in lora.named_parameters():
+        if not p.stop_gradient and n.endswith(".bias"):
+            assert ".base." in n, n  # only wrapped layers' biases
+    sd = lora_state_dict(lora)
+    assert any(k.endswith(".bias") for k in sd)
+    assert all("lora_" in k or ".base." in k for k in sd)
