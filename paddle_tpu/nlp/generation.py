@@ -22,7 +22,7 @@ from ..core import autograd
 from ..jit import functional_call
 
 __all__ = ["greedy_search", "generate_on_device", "sampling_search",
-           "beam_search", "generate"]
+           "beam_search", "generate", "speculative_greedy_search"]
 
 
 def _logits_fn(model, p_vals, ids, offset_val, kc, vc):
@@ -468,3 +468,75 @@ def generate(model, input_ids, max_new_tokens=32,
     raise ValueError(
         f"decode_strategy must be greedy_search|sampling|beam_search, "
         f"got {decode_strategy!r}")
+
+
+def speculative_greedy_search(target, draft, input_ids, max_new_tokens=32,
+                              gamma=4):
+    """Speculative decoding, greedy variant (reference: the speculative
+    decode serving mode in the reference NLP stack — unverified, SURVEY
+    §0): the DRAFT model proposes ``gamma`` tokens autoregressively, the
+    TARGET verifies them in ONE forward, and the longest prefix matching
+    the target's own greedy choices is accepted plus the target's
+    correction token. Output is EXACTLY the target's greedy decode —
+    the draft only changes how many target forwards it takes.
+
+    Both models share the vocab; batch 1 (acceptance lengths are
+    per-sequence). KV caches roll back by position: rejected slots are
+    simply overwritten on the next round (valid_len masks the stale
+    tail). Returns (tokens, acceptance_rate)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    input_ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle.to_tensor(input_ids)
+    b, s_in = input_ids.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is per-sequence (batch 1), got {b}")
+    total = s_in + max_new_tokens + gamma + 1
+    t_caches = target.init_caches(1, total)
+    d_caches = draft.init_caches(1, total)
+
+    t_logits, t_caches = target(input_ids, caches=t_caches)
+    d_logits, d_caches = draft(input_ids, caches=d_caches)
+    cur = int(np.asarray(t_logits._value)[0, -1].argmax())
+
+    out = [int(x) for x in np.asarray(input_ids._value)[0]] + [cur]
+    pos = s_in
+    n = 1
+    proposed = accepted = 0
+    while n < max_new_tokens:
+        g = min(gamma, max_new_tokens - n)
+        # draft proposes g tokens from `cur`
+        props = []
+        d_cur, d_pos = cur, pos
+        for _ in range(g):
+            dl, d_caches = draft(
+                paddle.to_tensor(np.asarray([[d_cur]], np.int32)),
+                caches=d_caches, position_offset=d_pos)
+            d_cur = int(np.asarray(dl._value)[0, -1].argmax())
+            props.append(d_cur)
+            d_pos += 1
+        # one target forward verifies all g proposals (+ bonus position)
+        seq = np.asarray([[cur] + props], np.int32)
+        tl, t_caches = target(paddle.to_tensor(seq), caches=t_caches,
+                              position_offset=pos)
+        t_choice = np.asarray(tl._value)[0].argmax(-1)  # (g+1,)
+        a = 0
+        while a < g and props[a] == int(t_choice[a]):
+            a += 1
+        emit = props[:a] + [int(t_choice[a])]
+        proposed += g
+        accepted += a
+        out.extend(emit)
+        n += len(emit)
+        cur = emit[-1]
+        pos += a + 1
+        # draft cache must also hold the accepted history: replay the
+        # correction token is unnecessary — the next round's first draft
+        # call writes `cur` at `pos`; slots beyond are stale and get
+        # overwritten (valid_len masks them)
+    tokens = paddle.to_tensor(
+        np.asarray([out[: s_in + max_new_tokens]], np.int32))
+    rate = accepted / max(proposed, 1)
+    return tokens, rate
