@@ -483,7 +483,12 @@ def speculative_greedy_search(target, draft, input_ids, max_new_tokens=32,
     Both models share the vocab; batch 1 (acceptance lengths are
     per-sequence). KV caches roll back by position: rejected slots are
     simply overwritten on the next round (valid_len masks the stale
-    tail). Returns (tokens, acceptance_rate)."""
+    tail) — which is also why sliding-window models are rejected up
+    front (a rolling buffer wrap-writes over live slots that rollback
+    cannot restore). Exactness caveat: the emitted tokens follow the
+    target's BATCHED verify forwards; a floating-point argmax tie can
+    in principle resolve differently there than in step-wise decode.
+    Returns (tokens, acceptance_rate)."""
     import numpy as np
     import paddle_tpu as paddle
 
@@ -493,12 +498,20 @@ def speculative_greedy_search(target, draft, input_ids, max_new_tokens=32,
     if b != 1:
         raise ValueError(
             f"speculative decoding is per-sequence (batch 1), got {b}")
+    for name, m in (("target", target), ("draft", draft)):
+        if getattr(m.config, "sliding_window", None):
+            raise NotImplementedError(
+                f"speculative decoding with a sliding-window {name} is "
+                f"not supported: rollback-by-overwrite cannot restore "
+                f"rolling-buffer slots the rejected proposals wrapped "
+                f"over")
     total = s_in + max_new_tokens + gamma + 1
     t_caches = target.init_caches(1, total)
     d_caches = draft.init_caches(1, total)
 
-    t_logits, t_caches = target(input_ids, caches=t_caches)
-    d_logits, d_caches = draft(input_ids, caches=d_caches)
+    with autograd.no_grad():
+        t_logits, t_caches = target(input_ids, caches=t_caches)
+        _, d_caches = draft(input_ids, caches=d_caches)
     cur = int(np.asarray(t_logits._value)[0, -1].argmax())
 
     out = [int(x) for x in np.asarray(input_ids._value)[0]] + [cur]
@@ -510,17 +523,18 @@ def speculative_greedy_search(target, draft, input_ids, max_new_tokens=32,
         # draft proposes g tokens from `cur`
         props = []
         d_cur, d_pos = cur, pos
-        for _ in range(g):
-            dl, d_caches = draft(
-                paddle.to_tensor(np.asarray([[d_cur]], np.int32)),
-                caches=d_caches, position_offset=d_pos)
-            d_cur = int(np.asarray(dl._value)[0, -1].argmax())
-            props.append(d_cur)
-            d_pos += 1
-        # one target forward verifies all g proposals (+ bonus position)
-        seq = np.asarray([[cur] + props], np.int32)
-        tl, t_caches = target(paddle.to_tensor(seq), caches=t_caches,
-                              position_offset=pos)
+        with autograd.no_grad():
+            for _ in range(g):
+                dl, d_caches = draft(
+                    paddle.to_tensor(np.asarray([[d_cur]], np.int32)),
+                    caches=d_caches, position_offset=d_pos)
+                d_cur = int(np.asarray(dl._value)[0, -1].argmax())
+                props.append(d_cur)
+                d_pos += 1
+            # one target forward verifies every proposal (+ bonus slot)
+            seq = np.asarray([[cur] + props], np.int32)
+            tl, t_caches = target(paddle.to_tensor(seq),
+                                  caches=t_caches, position_offset=pos)
         t_choice = np.asarray(tl._value)[0].argmax(-1)  # (g+1,)
         a = 0
         while a < g and props[a] == int(t_choice[a]):
