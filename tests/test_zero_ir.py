@@ -135,11 +135,15 @@ def test_no_involuntary_remat_reshards(capfd, stage3):
     assert "Involuntary full rematerialization" not in err, err[-2000:]
 
 
-def test_no_involuntary_remat_with_tp_and_zero(capfd):
+@pytest.mark.parametrize("fused_lce", [False, True])
+def test_no_involuntary_remat_with_tp_and_zero(capfd, fused_lce):
     """TP(mp=2) x ZeRO(sharding=4): dim-0 mp-sharded params (vocab
     embedding) must get moments whose dim-0 spec keeps mp MAJOR and adds
     the ZeRO axis minor — ('mp', 'sharding'), a per-device sub-slice —
-    and the whole step must compile with no involuntary remats."""
+    and the whole step must compile with no involuntary remats. The
+    fused_lce arm pins the round-5 hybrid recipe (chunked fused
+    lm-head+CE with an mp-sharded lm_head weight) to the same
+    zero-warning invariant."""
     from paddle_tpu.nlp import (
         LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
     )
@@ -151,9 +155,11 @@ def test_no_involuntary_remat_with_tp_and_zero(capfd):
     }
     fleet.init(is_collective=True, strategy=strategy)
     paddle.seed(0)
-    cfg = LlamaConfig.tiny(tensor_parallel=True)
+    cfg = LlamaConfig.tiny(tensor_parallel=True,
+                           fuse_linear_cross_entropy=fused_lce)
     model = LlamaForCausalLM(cfg)
-    crit = LlamaPretrainingCriterion()
+    crit = LlamaPretrainingCriterion(
+        cfg, lm_head=model.lm_head if fused_lce else None)
     opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
     step = JittedTrainStep(
         model, lambda out, labels: crit(out, labels), opt,
