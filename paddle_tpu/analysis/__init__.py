@@ -1,0 +1,53 @@
+"""paddle_tpu.analysis — static auditing of compiled programs and
+framework source (the CINN-style compiler-level verification layer of
+PAPER.md's blueprint, grown from tests/test_zero_ir.py's one-off IR
+string checks into a first-class subsystem).
+
+Three layers:
+
+1. **IR audit passes** over any jitted callable's jaxpr / StableHLO /
+   compiled HLO: collective-communication census
+   (:func:`collective_census`), involuntary-remat detection
+   (:func:`detect_involuntary_remat`), dtype-promotion audit
+   (:func:`audit_dtype_promotion`), buffer-donation audit
+   (:func:`audit_donation`) — all run at once by :func:`audit`.
+2. **Budgets**: :class:`Budget` + :func:`check_budget` enforce
+   declarative per-recipe expectations ("0 remat fallbacks, <=N
+   all-gathers, 0 f32 matmuls, everything donated"); the real recipes
+   live in :mod:`.recipes`.
+3. **Source linter**: ``python -m paddle_tpu.analysis.lint paddle_tpu/``
+   flags tracer hazards in the framework source itself (host syncs in
+   jit-reachable code, Python control flow on traced values, np.* on
+   tensors, mutable default args).
+
+CLI: ``python -m paddle_tpu.analysis`` audits the registered recipes.
+"""
+from .ir import LoweredTarget, lower_target, capture_compile_stderr
+from .collectives import (
+    COLLECTIVE_KINDS, CollectiveStats, collective_census,
+    reduce_scatter_pattern,
+)
+from .remat import RematEvent, detect_involuntary_remat
+from .dtypes import DtypeReport, F32ComputeEvent, audit_dtype_promotion
+from .donation import ArgDonation, DonationReport, audit_donation
+from .budget import (
+    AuditReport, Budget, BudgetViolation, audit, check_budget,
+)
+from .recipes import RECIPES, Recipe, build as build_recipe, \
+    run as run_recipe
+from .lint import LintViolation, lint_paths, lint_source
+
+__all__ = [
+    # ir
+    "LoweredTarget", "lower_target", "capture_compile_stderr",
+    # passes
+    "COLLECTIVE_KINDS", "CollectiveStats", "collective_census",
+    "reduce_scatter_pattern", "RematEvent", "detect_involuntary_remat",
+    "DtypeReport", "F32ComputeEvent", "audit_dtype_promotion",
+    "ArgDonation", "DonationReport", "audit_donation",
+    # budgets
+    "AuditReport", "Budget", "BudgetViolation", "audit", "check_budget",
+    "RECIPES", "Recipe", "build_recipe", "run_recipe",
+    # linter
+    "LintViolation", "lint_paths", "lint_source",
+]

@@ -1,0 +1,119 @@
+"""Graph-audit CLI::
+
+    python -m paddle_tpu.analysis                    # audit every recipe
+    python -m paddle_tpu.analysis --recipe NAME      # just one
+    python -m paddle_tpu.analysis --check            # enforce budgets
+    python -m paddle_tpu.analysis --json             # machine-readable
+
+Audits the registered recipes (see .recipes) — lowering + compiling
+each program and printing the collective census, remat events, dtype
+findings, and donation coverage. ``--check`` additionally enforces each
+recipe's budget and exits non-zero on any violation (the bench-suite /
+CI entry point). Source linting is the sibling CLI:
+``python -m paddle_tpu.analysis.lint paddle_tpu/``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import recipes
+from .budget import BudgetViolation
+from .collectives import COLLECTIVE_KINDS
+
+
+def _report_json(name, report, ok, violations):
+    return {
+        "recipe": name,
+        "budget_ok": ok,
+        "violations": violations,
+        "collectives": {
+            k: {"count": report.collectives[k].count,
+                "bytes": report.collectives[k].bytes}
+            for k in COLLECTIVE_KINDS
+        },
+        "involuntary_remat": len(report.remat_events),
+        "f32_matmuls_from_bf16": (
+            len(report.dtype.f32_compute)
+            if report.dtype is not None else None),
+        "bf16_to_f32_upcasts": (
+            report.dtype.upcasts if report.dtype is not None else None),
+        "donated_args": report.donation.donated_count,
+        "undonated_donatable_bytes": report.donation.undonated_bytes,
+    }
+
+
+_REEXEC_GUARD = "_PADDLE_TPU_ANALYSIS_REEXEC"
+
+
+def _ensure_mesh_devices(argv, need=8):
+    """The TP x ZeRO recipes need an 8-device mesh. `import paddle_tpu`
+    already initialized the jax backend by the time this CLI runs, so
+    on a too-small host platform the only way to grow it is to re-exec
+    ourselves with the conftest trick
+    (--xla_force_host_platform_device_count) set in the environment.
+    Inert on machines that already expose enough devices."""
+    import jax
+
+    if jax.device_count() >= need or os.environ.get(_REEXEC_GUARD):
+        return
+    flag = f"--xla_force_host_platform_device_count={need}"
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+    env[_REEXEC_GUARD] = "1"
+    cmd = [sys.executable, "-m", "paddle_tpu.analysis"] + list(
+        argv if argv is not None else sys.argv[1:])
+    os.execve(sys.executable, cmd, env)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="jaxpr/StableHLO graph auditor over the registered "
+                    "recipe programs")
+    ap.add_argument("--recipe", action="append", default=None,
+                    choices=sorted(recipes.RECIPES),
+                    help="recipe(s) to audit (default: all)")
+    ap.add_argument("--check", action="store_true",
+                    help="enforce each recipe's budget; exit 1 on any "
+                         "violation")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per recipe on stdout")
+    args = ap.parse_args(argv)
+
+    names = args.recipe or sorted(recipes.RECIPES)
+    _ensure_mesh_devices(argv)
+    failures = 0
+    for name in names:
+        recipe = recipes.build(name)
+        try:
+            ok, violations = True, []
+            if args.check:
+                try:
+                    report = recipe.check()
+                except BudgetViolation as e:
+                    report = e.report
+                    ok, violations = False, e.violations
+                    failures += 1
+            else:
+                report = recipe.audit()
+            if args.json:
+                print(json.dumps(_report_json(name, report, ok,
+                                              violations)))
+            else:
+                print(report.summary())
+                if args.check:
+                    print(f"  budget [{recipe.budget.name}]: "
+                          + ("OK" if ok else "VIOLATED"))
+                    for ln in violations:
+                        print(f"    ! {ln}")
+                print()
+        finally:
+            recipe.close()
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

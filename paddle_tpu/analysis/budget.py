@@ -1,0 +1,231 @@
+"""The budget mechanism: declarative per-recipe expectations over the
+audit passes, checked by ONE call usable from tests, benches, and CI::
+
+    from paddle_tpu import analysis
+    report = analysis.check_budget(
+        step, analysis.Budget(name="llama tp x zero",
+                              max_remat=0, max_all_gathers=8,
+                              max_f32_matmuls=0, require_donated=True),
+        inputs, labels)
+
+Every ``None`` field is unchecked; violations collect into ONE
+:class:`BudgetViolation` (an AssertionError, so plain pytest and the
+bench drivers both fail loudly with the full list).
+"""
+from __future__ import annotations
+
+from .ir import lower_target
+from .collectives import (
+    collective_census, reduce_scatter_pattern, COLLECTIVE_KINDS,
+)
+from .remat import detect_involuntary_remat
+from .dtypes import audit_dtype_promotion, DtypeReport
+from .donation import audit_donation
+
+__all__ = ["Budget", "BudgetViolation", "AuditReport", "audit",
+           "check_budget"]
+
+_BUDGET_FIELDS = (
+    "max_remat", "max_all_gathers", "max_all_reduces",
+    "max_reduce_scatters", "max_all_to_alls", "max_collective_permutes",
+    "max_total_collectives", "max_collective_bytes", "max_f32_matmuls",
+    "max_f32_upcasts", "max_undonated_bytes", "require_donated",
+    "require_reduce_scatter", "require_all_gather",
+)
+
+_KIND_FIELD = {
+    "all-gather": "max_all_gathers",
+    "all-reduce": "max_all_reduces",
+    "reduce-scatter": "max_reduce_scatters",
+    "all-to-all": "max_all_to_alls",
+    "collective-permute": "max_collective_permutes",
+}
+
+
+class Budget:
+    """Declarative expectations for one compiled program. ``None`` (the
+    default for every cap) means "not checked"; ``require_*`` flags
+    default to False.
+
+    Caps:
+        max_remat: involuntary-remat fallbacks (0 = the zero-remat
+            invariant).
+        max_all_gathers / max_all_reduces / max_reduce_scatters /
+            max_all_to_alls / max_collective_permutes: per-kind op
+            counts in the compiled module.
+        max_total_collectives / max_collective_bytes: across all kinds.
+        max_f32_matmuls: f32 dot/conv ops reachable from bf16/f16
+            values (0 = a bf16 graph stays bf16 on the MXU path).
+        max_f32_upcasts: bf16/f16 -> f32 convert ops.
+        max_undonated_bytes: bytes of donatable args left undonated.
+    Requirements:
+        require_donated: every donatable arg must be donated.
+        require_reduce_scatter: the stage-2 ZeRO pattern (fused
+            reduce-scatter, or the CPU backend's all-reduce +
+            dynamic-slice lowering of the same decision) must appear.
+        require_all_gather: at least one all-gather (ZeRO-3 on-demand
+            param gathering) must appear.
+    """
+
+    def __init__(self, name="", **caps):
+        self.name = name
+        unknown = set(caps) - set(_BUDGET_FIELDS)
+        if unknown:
+            raise TypeError(
+                f"unknown budget field(s) {sorted(unknown)}; valid: "
+                f"{_BUDGET_FIELDS}")
+        for f in _BUDGET_FIELDS:
+            default = False if f.startswith("require_") else None
+            setattr(self, f, caps.get(f, default))
+
+    def __repr__(self):
+        set_fields = {
+            f: getattr(self, f) for f in _BUDGET_FIELDS
+            if getattr(self, f) not in (None, False)
+        }
+        return f"Budget({self.name!r}, {set_fields})"
+
+
+class BudgetViolation(AssertionError):
+    """One or more budget caps exceeded; ``violations`` is the list of
+    human-readable lines."""
+
+    def __init__(self, name, violations, report):
+        self.violations = list(violations)
+        self.report = report
+        head = f"budget {name!r}: " if name else "budget: "
+        super().__init__(
+            head + f"{len(self.violations)} violation(s)\n  - "
+            + "\n  - ".join(self.violations))
+
+
+class AuditReport:
+    """Structured result of every pass over one compiled program."""
+
+    def __init__(self, name, collectives, remat_events, dtype_report,
+                 donation):
+        self.name = name
+        #: dict kind -> CollectiveStats
+        self.collectives = collectives
+        #: list[RematEvent]
+        self.remat_events = remat_events
+        #: DtypeReport (or None when the target has no jaxpr hook)
+        self.dtype = dtype_report
+        #: DonationReport
+        self.donation = donation
+
+    @property
+    def total_collectives(self):
+        return sum(s.count for s in self.collectives.values())
+
+    @property
+    def total_collective_bytes(self):
+        return sum(s.bytes for s in self.collectives.values())
+
+    def summary(self):
+        lines = [f"audit: {self.name}"]
+        lines.append("  collectives:")
+        for kind in COLLECTIVE_KINDS:
+            st = self.collectives[kind]
+            if st.count:
+                lines.append(
+                    f"    {kind:<20} x{st.count:<4} {st.bytes:>12,} B")
+        if not self.total_collectives:
+            lines.append("    (none)")
+        lines.append(
+            f"  involuntary remat: {len(self.remat_events)}")
+        for ev in self.remat_events[:4]:
+            lines.append(f"    {ev.hlo_op[:90]}")
+        if self.dtype is not None:
+            lines.append(
+                f"  f32 matmul/conv from bf16: "
+                f"{len(self.dtype.f32_compute)}; bf16->f32 upcasts: "
+                f"{self.dtype.upcasts}")
+            for ev in self.dtype.f32_compute[:4]:
+                lines.append(f"    {ev!r}")
+        d = self.donation
+        lines.append(
+            f"  donation: {d.donated_count}/{len(d.args)} args donated"
+            + (f"; {len(d.undonated())} donatable args UNDONATED "
+               f"({d.undonated_bytes:,} B)"
+               if d.n_donatable is not None else ""))
+        return "\n".join(lines)
+
+
+def audit(target, *args, **kwargs):
+    """Run every pass over ``target`` compiled with the example args;
+    returns :class:`AuditReport`. See :func:`.ir.lower_target` for the
+    supported target kinds."""
+    lt = lower_target(target, *args, **kwargs)
+    hlo = lt.compiled_text()
+    census = collective_census(hlo)
+    remat_events = detect_involuntary_remat(lt.compile_stderr())
+    try:
+        jaxpr = lt.jaxpr()
+    except Exception:  # a target whose jaxpr re-trace needs live state
+        jaxpr = None
+    dtype_report = (audit_dtype_promotion(jaxpr)
+                    if jaxpr is not None else None)
+    donation = audit_donation(lt.stablehlo_text(),
+                              n_donatable=lt.n_donatable)
+    report = AuditReport(lt.name, census, remat_events, dtype_report,
+                         donation)
+    report.hlo_text = hlo  # kept for pattern checks (reduce-scatter)
+    return report
+
+
+def check_budget(target, budget, *args, **kwargs):
+    """Audit ``target`` and enforce ``budget``; returns the
+    :class:`AuditReport` on success, raises :class:`BudgetViolation`
+    listing every exceeded cap otherwise."""
+    report = audit(target, *args, **kwargs)
+    v = []
+
+    def cap(limit, actual, what):
+        if limit is not None and actual > limit:
+            v.append(f"{what}: {actual} > budget {limit}")
+
+    cap(budget.max_remat, len(report.remat_events),
+        "involuntary remat fallbacks")
+    for kind, field in _KIND_FIELD.items():
+        cap(getattr(budget, field), report.collectives[kind].count,
+            f"{kind} count")
+    cap(budget.max_total_collectives, report.total_collectives,
+        "total collective count")
+    cap(budget.max_collective_bytes, report.total_collective_bytes,
+        "total collective bytes")
+    if report.dtype is not None:
+        cap(budget.max_f32_matmuls, len(report.dtype.f32_compute),
+            "f32 matmul/conv reachable from bf16")
+        cap(budget.max_f32_upcasts, report.dtype.upcasts,
+            "bf16->f32 upcasts")
+    elif budget.max_f32_matmuls is not None \
+            or budget.max_f32_upcasts is not None:
+        v.append("dtype budget set but target offers no jaxpr to audit")
+    cap(budget.max_undonated_bytes, report.donation.undonated_bytes,
+        "undonated donatable bytes")
+    if budget.require_donated:
+        und = report.donation.undonated()
+        if report.donation.n_donatable is None:
+            v.append("require_donated set but target does not declare "
+                     "its donatable args (n_donatable unknown)")
+        elif und:
+            v.append(
+                f"require_donated: {len(und)} donatable arg(s) not "
+                f"donated, e.g. {und[:3]}")
+    if budget.require_reduce_scatter and not reduce_scatter_pattern(
+            report.hlo_text, report.collectives):
+        v.append("require_reduce_scatter: no reduce-scatter decision "
+                 "(neither fused op nor all-reduce+dynamic-slice)")
+    if budget.require_all_gather \
+            and report.collectives["all-gather"].count == 0:
+        v.append("require_all_gather: no all-gather in compiled module")
+
+    for ev in (report.remat_events if budget.max_remat is not None
+               and len(report.remat_events) > (budget.max_remat or 0)
+               else [])[:2]:
+        v.append(f"  remat detail: {ev.raw[:180]}")
+
+    if v:
+        raise BudgetViolation(budget.name, v, report)
+    return report

@@ -1,0 +1,104 @@
+"""Collective-communication census over compiled (post-GSPMD) HLO.
+
+GSPMD inserts the ICI collectives AFTER jaxpr-land, so the only honest
+place to count them is the compiled module text. Each op definition
+looks like::
+
+    %all-gather.5 = f32[8,128]{1,0} all-gather(f32[1,128]{1,0} %x), ...
+    %rs = (f32[8,64]{1,0}, f32[8,64]{1,0}) reduce-scatter(...), ...
+
+We count definitions (never operand mentions) per collective kind and
+sum each op's RESULT byte volume — the per-step wire-adjacent number a
+budget caps. The async forms (``all-gather-start`` etc.) count as their
+base op; ``-done`` ops are skipped (same transfer, already counted).
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["CollectiveStats", "collective_census", "COLLECTIVE_KINDS",
+           "reduce_scatter_pattern", "parse_shape_bytes"]
+
+COLLECTIVE_KINDS = (
+    "all-gather", "all-reduce", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "s4": 1, "s8": 1, "u2": 1, "u4": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+# an op DEFINITION: "%name = <shape-or-tuple> <opname>(" — operand
+# mentions inside calls never match because they lack the " = " form
+_DEF_RE = re.compile(
+    r"=\s+(\([^)]*\)|\w+\[[\d,]*\][^\s]*)\s+"
+    r"(all-gather|all-reduce|reduce-scatter|all-to-all|"
+    r"collective-permute)(-start|-done)?\(")
+
+
+def parse_shape_bytes(shape_text):
+    """Byte volume of an HLO shape string — a single shape
+    (``f32[8,128]{1,0}``) or a tuple (``(f32[4], bf16[2,2])``)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _DTYPE_BYTES:
+            continue  # e.g. layout braces never match; tokens are dtypes
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+class CollectiveStats:
+    """count + result-bytes for one collective kind."""
+
+    __slots__ = ("count", "bytes")
+
+    def __init__(self, count=0, nbytes=0):
+        self.count = count
+        self.bytes = nbytes
+
+    def __repr__(self):
+        return f"CollectiveStats(count={self.count}, bytes={self.bytes})"
+
+    def __eq__(self, other):
+        return (isinstance(other, CollectiveStats)
+                and (self.count, self.bytes) == (other.count, other.bytes))
+
+
+def collective_census(hlo_text):
+    """dict kind -> :class:`CollectiveStats` over every collective-op
+    definition in the compiled module text (all kinds present, zeroed
+    when absent)."""
+    stats = {k: CollectiveStats() for k in COLLECTIVE_KINDS}
+    for m in _DEF_RE.finditer(hlo_text):
+        shape_text, kind, async_suffix = m.group(1), m.group(2), m.group(3)
+        if async_suffix == "-done":
+            continue
+        st = stats[kind]
+        st.count += 1
+        st.bytes += parse_shape_bytes(shape_text)
+    return stats
+
+
+def reduce_scatter_pattern(hlo_text, census=None):
+    """True when the module carries a reduce-scatter DECISION by the
+    partitioner: either the fused ``reduce-scatter`` op (TPU) or the
+    CPU backend's lowering of the same decision — ``all-reduce``
+    followed by ``dynamic-slice`` (each device keeps only its shard).
+    This generalizes tests/test_zero_ir.py's stage-2 invariant."""
+    census = census or collective_census(hlo_text)
+    if census["reduce-scatter"].count > 0:
+        return True
+    return (census["all-reduce"].count > 0
+            and "dynamic-slice" in hlo_text)

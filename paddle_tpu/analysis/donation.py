@@ -1,0 +1,136 @@
+"""Buffer-donation auditor.
+
+A train step that forgets to donate its param/state buffers doubles its
+HBM high-water mark: XLA must keep the inputs alive while materializing
+the updated copies. Donation is visible in the lowered StableHLO as
+per-argument attributes on ``@main`` —
+
+- ``tf.aliasing_output = N`` : donated AND aliased to output N;
+- ``jax.buffer_donor = true``: donated, alias left to the compiler —
+
+so the audit parses the entry signature and reports, per argument,
+(bytes, donated). ``n_donatable`` (when the target knows it — e.g.
+``JittedTrainStep.donatable_leaf_count()``) marks how many LEADING
+arguments hold param/optimizer/buffer state: every one of those left
+undonated is a violation candidate the budget can cap.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["ArgDonation", "DonationReport", "audit_donation"]
+
+_ELEM_BYTES = {
+    "i1": 1, "i2": 1, "i4": 1, "i8": 1, "ui2": 1, "ui4": 1, "ui8": 1,
+    "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i32": 4, "ui32": 4, "f32": 4,
+    "i64": 8, "ui64": 8, "f64": 8,
+    "complex<f32>": 8, "complex<f64>": 16,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+}
+
+# one argument in the @main signature:
+#   %arg7: tensor<64x128xf32> {tf.aliasing_output = 3 : i32, ...}
+# the attribute dict may contain QUOTED strings with braces inside
+# (mhlo.sharding = "{devices=[...]}"), so the attrs are scanned
+# brace/quote-aware rather than matched with [^}]*
+_ARG_HEAD_RE = re.compile(r"%arg(\d+):\s*tensor<([^>]*)>")
+
+
+def _scan_attrs(text, start):
+    """If text[start:] (after optional spaces) opens an attribute dict,
+    return its full text (respecting quoted strings); else ''."""
+    i = start
+    while i < len(text) and text[i] == " ":
+        i += 1
+    if i >= len(text) or text[i] != "{":
+        return ""
+    depth = 0
+    j = i
+    in_str = False
+    while j < len(text):
+        c = text[j]
+        if in_str:
+            if c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return text[i:j + 1]
+        j += 1
+    return ""
+
+
+def _tensor_bytes(tensor_body):
+    """bytes of 'tensor<...>' body text, e.g. '64x128xf32' or 'f32'."""
+    parts = tensor_body.split("x")
+    elem = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        if p.isdigit():
+            n *= int(p)
+    return n * _ELEM_BYTES.get(elem, 0)
+
+
+class ArgDonation:
+    __slots__ = ("index", "nbytes", "donated")
+
+    def __init__(self, index, nbytes, donated):
+        self.index = index
+        self.nbytes = nbytes
+        self.donated = donated
+
+    def __repr__(self):
+        return (f"ArgDonation(arg{self.index}, {self.nbytes}B, "
+                f"donated={self.donated})")
+
+
+class DonationReport:
+    __slots__ = ("args", "n_donatable")
+
+    def __init__(self, args, n_donatable=None):
+        self.args = args
+        self.n_donatable = n_donatable
+
+    @property
+    def donated_count(self):
+        return sum(1 for a in self.args if a.donated)
+
+    def undonated(self, within_first=None):
+        """Arguments NOT donated among the first ``within_first``
+        (default: ``n_donatable``). When neither is known the report
+        cannot say what SHOULD have been donated and returns [] —
+        pass ``within_first=len(report.args)`` to list every
+        undonated arg regardless."""
+        limit = within_first if within_first is not None else \
+            self.n_donatable
+        if limit is None:
+            return []
+        return [a for a in self.args
+                if not a.donated and a.index < limit]
+
+    @property
+    def undonated_bytes(self):
+        return sum(a.nbytes for a in self.undonated())
+
+
+def audit_donation(stablehlo_text, n_donatable=None):
+    """Parse @main's argument attributes into a
+    :class:`DonationReport`."""
+    args = []
+    for m in _ARG_HEAD_RE.finditer(stablehlo_text):
+        idx = int(m.group(1))
+        attrs = _scan_attrs(stablehlo_text, m.end())
+        donated = ("tf.aliasing_output" in attrs
+                   or "jax.buffer_donor" in attrs)
+        args.append(ArgDonation(idx, _tensor_bytes(m.group(2)), donated))
+    # keep the FIRST occurrence per index (inner funcs also use %argN)
+    seen = {}
+    for a in args:
+        seen.setdefault(a.index, a)
+    ordered = [seen[i] for i in sorted(seen)]
+    return DonationReport(ordered, n_donatable=n_donatable)

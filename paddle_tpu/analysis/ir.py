@@ -1,0 +1,159 @@
+"""Target normalization + IR extraction for the analysis passes.
+
+Every audit starts the same way: take "something jittable" — a
+``JittedTrainStep``, a ``jax.jit``-compiled function, a
+``paddle.jit.to_static`` ``StaticFunction``, or a plain callable — plus
+one example batch, and produce the three IR views the passes walk:
+
+- the ClosedJaxpr (pre-partitioning; the dtype auditor's view),
+- the StableHLO module text (carries donation/aliasing arg attributes),
+- the compiled (post-GSPMD, post-fusion) HLO text (collective census),
+  together with everything XLA logged to fd 2 DURING that compile (the
+  involuntary-remat detector's view — the SPMD partitioner logs its
+  rematerialization fallbacks there, C++-side, so a Python-level
+  ``sys.stderr`` swap would miss them).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+import jax
+
+__all__ = [
+    "LoweredTarget", "lower_target", "capture_compile_stderr",
+]
+
+
+@contextlib.contextmanager
+def capture_compile_stderr():
+    """Redirect OS-level fd 2 into a temp file for the duration (XLA's
+    C++ logging bypasses sys.stderr). Yields a ``read()``-able handle:
+    call it AFTER the with-block for the captured text."""
+    captured = {"text": ""}
+    saved = os.dup(2)
+    tmp = tempfile.TemporaryFile(mode="w+b")
+    try:
+        os.dup2(tmp.fileno(), 2)
+        yield captured
+    finally:
+        os.dup2(saved, 2)
+        os.close(saved)
+        try:
+            tmp.flush()
+            tmp.seek(0)
+            captured["text"] = tmp.read().decode("utf-8", "replace")
+        finally:
+            tmp.close()
+
+
+def _unwrap(a):
+    from ..core.tensor import Tensor
+
+    return a._value if isinstance(a, Tensor) else a
+
+
+class LoweredTarget:
+    """Lazy holder of the three IR views for one (target, example-args)
+    pair; each view is computed at most once."""
+
+    def __init__(self, name, lower_fn, jaxpr_fn=None, n_donatable=None):
+        self.name = name
+        self._lower_fn = lower_fn
+        self._jaxpr_fn = jaxpr_fn
+        #: how many leading jit args SHOULD be donated (None = unknown:
+        #: the donation audit then only reports, never requires)
+        self.n_donatable = n_donatable
+        self._lowered = None
+        self._compiled = None
+        self._compile_stderr = None
+
+    @property
+    def lowered(self):
+        if self._lowered is None:
+            self._lowered = self._lower_fn()
+        return self._lowered
+
+    def stablehlo_text(self):
+        return self.lowered.as_text()
+
+    def compiled_text(self):
+        self._ensure_compiled()
+        return self._compiled.as_text()
+
+    def compile_stderr(self):
+        """Everything XLA wrote to fd 2 while compiling this target
+        (the remat detector greps it)."""
+        self._ensure_compiled()
+        return self._compile_stderr
+
+    def _ensure_compiled(self):
+        if self._compiled is None:
+            # a prior in-process compile of the same computation would
+            # be served from jax's compilation cache SILENTLY — no
+            # partitioner log lines, so the remat pass would see a
+            # falsely clean stderr. Audits are rare; pay the recompile.
+            jax.clear_caches()
+            with capture_compile_stderr() as cap:
+                self._compiled = self.lowered.compile()
+            self._compile_stderr = cap["text"]
+
+    def jaxpr(self):
+        """ClosedJaxpr, or None when the target offers no jaxpr hook."""
+        return self._jaxpr_fn() if self._jaxpr_fn is not None else None
+
+
+def lower_target(target, *args, **kwargs):
+    """Normalize any supported target into a :class:`LoweredTarget`.
+
+    Supported targets:
+    - ``JittedTrainStep``: ``args`` = (inputs, labels); uses its
+      ``lower``/``step_jaxpr``/``donatable_leaf_count`` hooks.
+    - a ``jax.jit``-compiled function: called with the example args
+      (Tensors are unwrapped to their jax values).
+    - a ``StaticFunction`` (paddle.jit.to_static): uses its ``lowered``
+      hook.
+    - any plain callable: wrapped in ``jax.jit`` first.
+    """
+    from ..jit.train import JittedTrainStep
+    from ..jit import StaticFunction
+
+    if isinstance(target, JittedTrainStep):
+        if len(args) != 2:
+            raise TypeError(
+                "auditing a JittedTrainStep takes exactly (inputs, "
+                f"labels) as example args, got {len(args)}")
+        inputs, labels = args
+        return LoweredTarget(
+            type(target).__name__,
+            lambda: target.lower(inputs, labels),
+            jaxpr_fn=lambda: target.step_jaxpr(inputs, labels),
+            # the step knows its param/state/buffer leaves whether or
+            # not it donates them — a donate=False step then reports
+            # every one as undonated instead of "unknown"
+            n_donatable=target.donatable_leaf_count(),
+        )
+
+    if isinstance(target, StaticFunction):
+        return LoweredTarget(
+            getattr(target, "__name__", "StaticFunction"),
+            lambda: target.lowered(*args, **kwargs),
+        )
+
+    vals = [_unwrap(a) for a in args]
+    kw = {k: _unwrap(v) for k, v in kwargs.items()}
+    name = getattr(target, "__name__", type(target).__name__)
+    if hasattr(target, "lower"):  # already jax.jit-compiled
+        jitted = target
+    elif callable(target):
+        jitted = jax.jit(target)
+    else:
+        raise TypeError(f"cannot audit object of type {type(target)!r}")
+    return LoweredTarget(
+        name,
+        lambda: jitted.lower(*vals, **kw),
+        # make_jaxpr traces through the pjit wrapper, so jitted and
+        # plain callables share one path
+        jaxpr_fn=lambda: jax.make_jaxpr(jitted)(*vals, **kw),
+    )

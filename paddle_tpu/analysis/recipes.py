@@ -1,0 +1,158 @@
+"""Real-recipe budget registry: the compiled programs the bench history
+actually protects, each paired with the budget that pins its current
+known-good graph shape.
+
+- ``llama_tp_zero_fused_lce``: the TP(mp=2) x ZeRO(sharding=4)
+  fused-LCE train step — the round-5 hybrid recipe whose zero-remat
+  invariant guards the 67% MFU B2 result (BENCH_NOTES.md). Budget: 0
+  involuntary remats, the stage-2 reduce-scatter decision present,
+  every param/state/buffer leaf donated, and a hard cap on per-step
+  all-gather traffic.
+- ``llama_decode_greedy``: the whole-loop on-device greedy decode
+  (one-dispatch serving shape) on a bf16 tiny llama. Budget: a
+  single-chip program stays collective-free, and the bf16 graph stays
+  bf16 — 0 f32 matmuls reachable from the bf16 params.
+
+``build(name)`` constructs the recipe (installing the mesh it needs)
+and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
+report and ``recipe.close()`` (or use ``run(name)``) to restore global
+mesh state. Used by tests/test_zero_ir.py, tests/test_analysis.py, the
+``python -m paddle_tpu.analysis`` CLI, and scripts/bench_suite.py.
+"""
+from __future__ import annotations
+
+from .budget import Budget, check_budget, audit
+
+__all__ = ["Recipe", "RECIPES", "build", "run"]
+
+
+class Recipe:
+    """One auditable (target, example-args, budget) triple plus the
+    teardown that undoes any global state its builder installed."""
+
+    def __init__(self, name, target, args, budget, teardown=None):
+        self.name = name
+        self.target = target
+        self.args = tuple(args)
+        self.budget = budget
+        self._teardown = teardown
+
+    def audit(self):
+        return audit(self.target, *self.args)
+
+    def check(self):
+        return check_budget(self.target, self.budget, *self.args)
+
+    def close(self):
+        if self._teardown is not None:
+            self._teardown()
+            self._teardown = None
+
+
+def _mesh_teardown():
+    from ..parallel import mesh as mesh_state
+
+    def teardown():
+        mesh_state.set_mesh(None)
+
+    return teardown
+
+
+def _build_llama_tp_zero_fused_lce():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..distributed import fleet
+    from ..jit.train import JittedTrainStep
+    from ..nlp import (
+        LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+    )
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 1,
+        "sharding_degree": 4,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=True,
+                           fuse_linear_cross_entropy=True)
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion(cfg, lm_head=model.lm_head)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    step = JittedTrainStep(
+        model, lambda out, labels: crit(out, labels), opt,
+        state_sharding_axis="sharding",
+    )
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 32)))
+    budget = Budget(
+        name="llama tp2 x zero4 fused-LCE train step",
+        max_remat=0,
+        require_reduce_scatter=True,
+        require_donated=True,
+        # pinned ~25% above the audited graph (see test_analysis):
+        # headroom for benign partitioner drift, but a structural
+        # regression (per-layer re-gather, lost fusion) blows through it
+        max_all_gathers=80,
+        max_f32_matmuls=0,
+    )
+    return Recipe("llama_tp_zero_fused_lce", step, (ids, ids), budget,
+                  teardown=_mesh_teardown())
+
+
+def _build_llama_decode_greedy():
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..nlp.generation import generate_on_device
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (1, 8)))
+    max_new = 8
+    # populate the per-model compiled-program cache, then audit the
+    # EXACT program the serving path dispatches
+    generate_on_device(model, ids, max_new_tokens=max_new)
+    (jitted,) = [
+        fn for key, fn in model._generate_jit_cache.items()
+        if key[0] == "greedy"
+    ]
+    p_vals = [p._value for _, p in model.named_parameters()]
+    args = (p_vals, ids._value, jax.random.PRNGKey(0))
+    budget = Budget(
+        name="llama on-device greedy decode (bf16, single chip)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip program: any collective
+                                  # means an accidental mesh dependency
+        max_f32_matmuls=0,        # bf16 serving graph stays bf16
+    )
+    return Recipe("llama_decode_greedy", jitted, args, budget)
+
+
+RECIPES = {
+    "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
+    "llama_decode_greedy": _build_llama_decode_greedy,
+}
+
+
+def build(name):
+    try:
+        builder = RECIPES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown recipe {name!r}; available: {sorted(RECIPES)}")
+    return builder()
+
+
+def run(name):
+    """Build + budget-check one recipe; returns the AuditReport and
+    restores global mesh state."""
+    recipe = build(name)
+    try:
+        return recipe.check()
+    finally:
+        recipe.close()

@@ -363,9 +363,11 @@ class StaticFunction:
     def concrete_program(self, *args):
         return None
 
-    def get_stablehlo(self, *args, **kwargs):
-        """Lower the traced function to StableHLO text (the reference's
-        CINN fused-subgraph analog — SURVEY.md §2.2 TPU mapping note)."""
+    def lowered(self, *args, **kwargs):
+        """Lower the most-recent specialization for these args to a
+        ``jax.stages.Lowered`` (compiles the call first if this
+        signature was never traced) — the hook ``paddle_tpu.analysis``
+        audits to walk a to_static program's StableHLO/compiled HLO."""
         layer, _, call_args = self._get_layer(args)
         tensor_args = [a for a in call_args if isinstance(a, Tensor)]
         params = [p for _, p in layer.named_parameters()] if layer else []
@@ -375,12 +377,17 @@ class StaticFunction:
         entry = next(iter(self._jit_cache.values()))
         guards = entry["mru"] if entry["mru"] in entry["specs"] else ()
         jitted = entry["specs"][guards][0]
-        lowered = jitted.lower(
+        return jitted.lower(
             [t._value for t in tensor_args],
             [p._value for p in params],
             [b._value for b in buffers],
             jax.random.PRNGKey(0),
         )
+
+    def get_stablehlo(self, *args, **kwargs):
+        """Lower the traced function to StableHLO text (the reference's
+        CINN fused-subgraph analog — SURVEY.md §2.2 TPU mapping note)."""
+        lowered = self.lowered(*args, **kwargs)
         return str(lowered.compiler_ir(dialect="stablehlo"))
 
 

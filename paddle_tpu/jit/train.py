@@ -143,6 +143,8 @@ class JittedTrainStep:
                 (inputs_stacked, labels_stacked))
             return losses, p, s, b
 
+        self._donate = bool(donate)
+        self._step_fn = step_fn  # analysis hook: the pure step function
         donate_args = (0, 1, 2) if donate else ()
         jit_kw = {}
         if mesh_state.has_mesh():
@@ -159,24 +161,67 @@ class JittedTrainStep:
         self._jitted_multi = jax.jit(
             multi_step_fn, donate_argnums=donate_args, **jit_kw)
 
-    def __call__(self, inputs, labels):
-        """inputs/labels: Tensor or list of Tensors. Returns loss Tensor."""
+    def _batch_args(self, inputs, labels):
+        """Normalize/place one example batch: (in_vals, lb_vals, lr,
+        step_no) exactly as __call__ would feed the jitted program."""
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
         in_vals = [self._place_input(t) for t in inputs]
         lb_vals = [self._place_input(t) for t in labels]
-        from ..core.random import next_key
-
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self._step_no + 1, jnp.int32)
+        return in_vals, lb_vals, lr, step_no
+
+    def __call__(self, inputs, labels):
+        """inputs/labels: Tensor or list of Tensors. Returns loss Tensor."""
+        in_vals, lb_vals, lr, step_no = self._batch_args(inputs, labels)
+        from ..core.random import next_key
+
         loss, self._p_vals, self._s_vals, self._b_vals = self._jitted(
             self._p_vals, self._s_vals, self._b_vals, next_key(), lr,
             step_no, in_vals, lb_vals,
         )
         self._step_no += 1
         return Tensor(loss)
+
+    # -- lowered-IR hooks (paddle_tpu.analysis audits compile THESE) -------
+    def lower(self, inputs, labels):
+        """Lower (do not run) the single-step program for the CURRENT
+        param/state values and the given example batch; returns the
+        ``jax.stages.Lowered`` whose StableHLO / compiled HLO the
+        analysis passes walk."""
+        in_vals, lb_vals, lr, step_no = self._batch_args(inputs, labels)
+        from ..core.random import next_key
+
+        return self._jitted.lower(
+            self._p_vals, self._s_vals, self._b_vals, next_key(), lr,
+            step_no, in_vals, lb_vals,
+        )
+
+    def step_jaxpr(self, inputs, labels):
+        """The step's ClosedJaxpr (pre-partitioning IR) for the current
+        state — the dtype-promotion auditor walks this."""
+        in_vals, lb_vals, lr, step_no = self._batch_args(inputs, labels)
+        from ..core.random import next_key
+
+        return jax.make_jaxpr(self._step_fn)(
+            self._p_vals, self._s_vals, self._b_vals, next_key(), lr,
+            step_no, in_vals, lb_vals,
+        )
+
+    def donatable_leaf_count(self):
+        """How many leading jit arguments are param/state/buffer leaves
+        (the ones ``donate=True`` hands back to XLA): the donation audit
+        checks exactly these are aliased in the lowered program."""
+        flat, _ = jax.tree_util.tree_flatten(
+            (self._p_vals, self._s_vals, self._b_vals))
+        return len(flat)
+
+    @property
+    def donate(self):
+        return self._donate
 
     def run_steps(self, inputs_stacked, labels_stacked):
         """Run K train steps in ONE dispatch. inputs/labels carry a leading

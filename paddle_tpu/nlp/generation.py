@@ -546,10 +546,21 @@ def speculative_greedy_search(target, draft, input_ids, max_new_tokens=32,
         n += len(emit)
         cur = emit[-1]
         pos += a + 1
-        # draft cache must also hold the accepted history: replay the
-        # correction token is unnecessary — the next round's first draft
-        # call writes `cur` at `pos`; slots beyond are stale and get
-        # overwritten (valid_len masks them)
+        # draft cache must also hold the accepted history. Partial
+        # accept (a < g): replaying the correction token is unnecessary
+        # — the next round's first draft call writes `cur` at `pos`;
+        # slots beyond are stale and get overwritten (valid_len masks
+        # them). FULL accept (a == g): the draft proposed props[g-1]
+        # but never consumed it (the loop fed cur, props[:g-1]), and
+        # pos advances by g+1, so slot pos-1 would stay stale/zero
+        # forever and every later draft forward would attend a hole in
+        # the accepted history — run the one extra draft forward now.
+        if a == g and n < max_new_tokens:
+            with autograd.no_grad():
+                _, d_caches = draft(
+                    paddle.to_tensor(np.asarray([[props[g - 1]]],
+                                                np.int32)),
+                    caches=d_caches, position_offset=pos - 1)
     tokens = paddle.to_tensor(
         np.asarray([out[: s_in + max_new_tokens]], np.int32))
     rate = accepted / max(proposed, 1)

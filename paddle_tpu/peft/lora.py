@@ -59,11 +59,13 @@ class LoRALinear(Layer):
         self.r = int(r)
         self.scaling = float(lora_alpha) / float(r)
         self.lora_dropout = float(lora_dropout)
-        # reference init: A ~ N(0, 1/r) (kaiming-ish), B = 0 → the
-        # adapted forward starts bit-equal to the base forward
+        # reference init: A ~ N(0, 1/r) i.e. std = sqrt(1/r)
+        # (kaiming-ish), B = 0 → the adapted forward starts bit-equal
+        # to the base forward. (ADVICE round-5 low: std=1.0/r gave
+        # variance 1/r², shrinking adapter updates as r grew.)
         self.lora_A = self.create_parameter(
             (in_features, self.r),
-            default_initializer=I.Normal(std=1.0 / self.r))
+            default_initializer=I.Normal(std=(1.0 / self.r) ** 0.5))
         self.lora_B = self.create_parameter(
             (self.r, out_features), default_initializer=I.Constant(0.0))
         self._merged = False

@@ -858,7 +858,36 @@ def llama_7b_shape_serving():
     }
 
 
+def graph_audit():
+    """Compiled-graph budget gate for the bench recipes: before trusting
+    any perf number, assert the registered analysis budgets still hold
+    (0 involuntary remats, bounded collective counts/bytes, bf16 graphs
+    stay bf16, train state donated). One JSON row aggregating the
+    per-recipe census; a budget violation reports as the standard
+    error row, failing the suite entry loudly."""
+    from paddle_tpu import analysis
+
+    rows = {}
+    for name in sorted(analysis.RECIPES):
+        report = analysis.run_recipe(name)  # raises BudgetViolation
+        rows[name] = {
+            "collectives": {
+                k: report.collectives[k].count
+                for k in analysis.COLLECTIVE_KINDS
+                if report.collectives[k].count
+            },
+            "collective_bytes": report.total_collective_bytes,
+            "remat": len(report.remat_events),
+            "f32_matmuls": (len(report.dtype.f32_compute)
+                            if report.dtype else None),
+        }
+    return {"metric": "graph_audit_budgets_ok", "value": len(rows),
+            "unit": "recipes", **{f"recipe_{k}": v
+                                  for k, v in rows.items()}}
+
+
 CONFIGS = {
+    "graph_audit": graph_audit,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -41,6 +41,8 @@ def main():
         "sparse.txt": _callables(paddle.sparse),
         "incubate_functional.txt": _callables(
             paddle.incubate.nn.functional),
+        "analysis.txt": _callables(
+            __import__("paddle_tpu.analysis", fromlist=["analysis"])),
     }
     for fname, names in sets.items():
         path = os.path.join(OUT, fname)

@@ -1,0 +1,249 @@
+"""paddle_tpu.analysis — graph auditor + budget mechanism.
+
+Each IR pass gets a KNOWN-BAD function it must flag and a KNOWN-CLEAN
+function it must not, plus the two registered real-recipe budgets
+(the TP x ZeRO fused-LCE train step and the on-device greedy decode)
+which must hold on the current code — these are the machine-checked
+"did not regress the compiled graph" guarantees every future perf PR
+inherits."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.parallel import mesh as mesh_state
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    mesh_state.set_mesh(None)
+
+
+def _mesh(shape, axes):
+    return Mesh(np.array(jax.devices()).reshape(*shape), axes)
+
+
+# ---------------------------------------------------------------- census
+
+def test_collective_census_counts_and_bytes():
+    mesh = _mesh((8,), ("dp",))
+
+    def step(p, x):
+        g = jnp.dot(x, p)
+        return p - 0.1 * jnp.dot(x.T, g)
+
+    p = jax.device_put(jnp.zeros((64, 64)), NamedSharding(mesh, P()))
+    x = jax.device_put(jnp.ones((8, 64)),
+                       NamedSharding(mesh, P("dp")))
+    report = analysis.audit(jax.jit(step), p, x)
+    # dp grads reduce over the mesh: exactly one all-reduce of the
+    # (64, 64) f32 gradient
+    st = report.collectives["all-reduce"]
+    assert st.count == 1
+    assert st.bytes == 64 * 64 * 4
+    assert report.collectives["all-gather"].count == 0
+    assert report.total_collectives == 1
+
+
+def test_parse_shape_bytes_tuple_and_scalars():
+    from paddle_tpu.analysis.collectives import parse_shape_bytes
+
+    assert parse_shape_bytes("f32[8,128]{1,0}") == 8 * 128 * 4
+    assert parse_shape_bytes("(bf16[4,4], f32[2])") == 4 * 4 * 2 + 2 * 4
+    assert parse_shape_bytes("pred[]") == 1
+
+
+def test_census_known_clean_single_device():
+    report = analysis.audit(lambda a, b: jnp.dot(a, b),
+                            jnp.ones((4, 4)), jnp.ones((4, 4)))
+    assert report.total_collectives == 0
+
+
+# ----------------------------------------------------------------- remat
+
+def test_remat_pass_flags_incompatible_reshard():
+    """Known-bad: a mid-graph sharding flip between transposed device
+    orders forces GSPMD into replicate-then-repartition."""
+    mesh = _mesh((4, 2), ("sharding", "mp"))
+    v = jax.device_put(jnp.zeros((64, 64)),
+                       NamedSharding(mesh, P(None, "mp")))
+
+    def bad(a):
+        b = jax.lax.with_sharding_constraint(
+            jnp.sin(a), NamedSharding(mesh, P("sharding", None)))
+        return jnp.cos(b)
+
+    report = analysis.audit(jax.jit(bad), v)
+    assert len(report.remat_events) >= 1
+    ev = report.remat_events[0]
+    assert ev.from_sharding and ev.to_sharding
+    with pytest.raises(analysis.BudgetViolation, match="remat"):
+        analysis.check_budget(jax.jit(bad),
+                              analysis.Budget(max_remat=0), v)
+
+
+def test_remat_pass_clean_on_consistent_layout():
+    mesh = _mesh((4, 2), ("sharding", "mp"))
+    v = jax.device_put(jnp.zeros((64, 64)),
+                       NamedSharding(mesh, P(None, "mp")))
+
+    def clean(a):
+        return jnp.cos(jnp.sin(a))
+
+    report = analysis.check_budget(
+        jax.jit(clean), analysis.Budget(max_remat=0), v)
+    assert report.remat_events == []
+
+
+# ----------------------------------------------------------------- dtype
+
+def test_dtype_pass_flags_deliberate_f32_upcast():
+    """Known-bad: bf16 operands promoted to f32 before the matmul —
+    the exact mistake that silently halves MXU rate."""
+    def bad(w, x):
+        return jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))
+
+    w = jnp.zeros((4, 4), jnp.bfloat16)
+    x = jnp.zeros((2, 4), jnp.bfloat16)
+    report = analysis.audit(bad, w, x)
+    assert len(report.dtype.f32_compute) == 1
+    assert report.dtype.f32_compute[0].primitive == "dot_general"
+    assert report.dtype.upcasts == 2
+    with pytest.raises(analysis.BudgetViolation, match="f32"):
+        analysis.check_budget(
+            bad, analysis.Budget(max_f32_matmuls=0), w, x)
+
+
+def test_dtype_pass_clean_on_bf16_matmul():
+    def clean(w, x):
+        y = jnp.dot(x, w)          # stays bf16
+        return y.sum(dtype=jnp.float32)  # f32 REDUCTION is fine
+
+    w = jnp.zeros((4, 4), jnp.bfloat16)
+    x = jnp.zeros((2, 4), jnp.bfloat16)
+    report = analysis.check_budget(
+        clean, analysis.Budget(max_f32_matmuls=0), w, x)
+    assert report.dtype.f32_compute == []
+
+
+def test_dtype_pass_sees_through_scan():
+    """Taint must follow bf16 values into sub-jaxprs (scan bodies are
+    where decode-loop upcasts hide)."""
+    def bad(w, xs):
+        def body(c, x):
+            y = jnp.dot(x.astype(jnp.float32),
+                        w.astype(jnp.float32))
+            return c + y.sum(), y
+        return jax.lax.scan(body, jnp.float32(0), xs)
+
+    w = jnp.zeros((4, 4), jnp.bfloat16)
+    xs = jnp.zeros((3, 2, 4), jnp.bfloat16)
+    report = analysis.audit(bad, w, xs)
+    assert any(ev.path for ev in report.dtype.f32_compute), \
+        report.dtype.f32_compute
+
+
+# -------------------------------------------------------------- donation
+
+def test_donation_pass_flags_undonated_train_state():
+    """Known-bad: an update step whose state rides through undonated —
+    XLA must double-buffer the params."""
+    def update(p, g):
+        return p - 0.1 * g
+
+    p = jnp.zeros((128, 128))
+    g = jnp.ones((128, 128))
+    bad = jax.jit(update)                       # nothing donated
+    good = jax.jit(update, donate_argnums=(0,))
+
+    rep_bad = analysis.audit(bad, p, g)
+    assert rep_bad.donation.donated_count == 0
+    rep_good = analysis.audit(good, p, g)
+    assert rep_good.donation.args[0].donated
+    assert not rep_good.donation.args[1].donated
+
+
+def test_donation_budget_on_jitted_train_step():
+    """JittedTrainStep declares its donatable leaves; require_donated
+    passes with donate=True and fails with donate=False."""
+    from paddle_tpu.jit.train import JittedTrainStep
+    import paddle_tpu.nn as nn
+
+    def build(donate):
+        paddle.seed(0)
+        model = nn.Linear(16, 16)
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        mse = nn.MSELoss()
+        return JittedTrainStep(model, lambda o, y: mse(o, y), opt,
+                               donate=donate)
+
+    x = paddle.to_tensor(np.ones((4, 16), np.float32))
+    good = build(donate=True)
+    report = analysis.check_budget(
+        good, analysis.Budget(require_donated=True, max_remat=0), x, x)
+    assert report.donation.undonated() == []
+
+    bad = build(donate=False)
+    with pytest.raises(analysis.BudgetViolation, match="donat"):
+        analysis.check_budget(
+            bad, analysis.Budget(require_donated=True), x, x)
+
+
+# ---------------------------------------------------------------- budget
+
+def test_budget_rejects_unknown_fields():
+    with pytest.raises(TypeError, match="unknown budget field"):
+        analysis.Budget(max_all_gather=3)  # typo'd name
+
+
+def test_budget_violations_aggregate():
+    mesh = _mesh((8,), ("dp",))
+
+    def step(p, x):
+        g = jnp.dot(x, p)
+        return p - 0.1 * jnp.dot(x.T, g)
+
+    p = jax.device_put(jnp.zeros((64, 64)), NamedSharding(mesh, P()))
+    x = jax.device_put(jnp.ones((8, 64)),
+                       NamedSharding(mesh, P("dp")))
+    jitted = jax.jit(step)
+    with pytest.raises(analysis.BudgetViolation) as ei:
+        analysis.check_budget(
+            jitted,
+            analysis.Budget(name="toy", max_all_reduces=0,
+                            max_collective_bytes=0), p, x)
+    msg = str(ei.value)
+    assert "all-reduce count" in msg and "collective bytes" in msg
+    assert ei.value.report.total_collectives == 1
+
+
+# --------------------------------------------------- real-recipe budgets
+
+def test_recipe_budget_tp_zero_fused_lce():
+    """The round-5 hybrid recipe compiles within its declared budget:
+    0 involuntary remats, the stage-2 reduce-scatter decision present,
+    every param/state/buffer leaf donated, bounded all-gather count."""
+    report = analysis.run_recipe("llama_tp_zero_fused_lce")
+    assert report.remat_events == []
+    assert report.collectives["all-gather"].count > 0  # TP really talks
+    assert report.donation.undonated() == []
+
+
+def test_recipe_budget_decode_greedy():
+    """The single-chip bf16 serving loop: no collectives (any would be
+    an accidental mesh dependency) and the bf16 graph stays bf16."""
+    report = analysis.run_recipe("llama_decode_greedy")
+    assert report.total_collectives == 0
+    assert report.dtype is not None
+    assert report.dtype.f32_compute == []
+
+
+def test_audit_summary_is_printable():
+    report = analysis.audit(lambda a: a * 2, jnp.ones((4,)))
+    text = report.summary()
+    assert "collectives" in text and "remat" in text

@@ -1,0 +1,178 @@
+"""paddle_tpu.analysis.lint — tracer-hazard AST linter.
+
+Rule-level tests run the linter over synthetic known-bad/known-clean
+sources; the REPO GATE runs it over the installed ``paddle_tpu/`` tree
+with the checked-in allowlist, so any new host sync, traced-value
+branch, np.-on-tensor, or mutable default introduced by a future PR
+fails tier-1 — and stale allowlist entries fail it too, so the list
+cannot rot."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis.lint import (
+    DEFAULT_ALLOWLIST, lint_source, lint_paths, load_allowlist,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BAD_SOURCE = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def step(x, y):
+    v = x + y
+    if v.sum() > 0:            # H104: traced branch
+        v = v * 2
+    n = float(v.sum())         # H102: host cast
+    host = v.numpy()           # H101: host sync
+    w = np.square(v)           # H103: numpy on traced
+    while v.mean() < 1:        # H104
+        v = v + 1
+    return v
+
+def outer(xs):
+    def body(carry, x):
+        return carry + x, carry.item()   # H101, nested jit scope
+    return jax.lax.scan(body, 0.0, xs)
+
+def helper(a, b=[]):           # H105: mutable default
+    b.append(a)
+    return b
+'''
+
+CLEAN_SOURCE = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def eager_api(t):
+    # host-side eager op: .numpy()/float() are its JOB, not a hazard
+    return float(np.asarray(t.numpy()).sum())
+
+@jax.jit
+def clean(x, eos=None):
+    if eos is not None:        # static None-check
+        x = x + eos
+    if x.ndim == 2:            # .ndim is static under tracing
+        x = x[None]
+    if len(x.shape) > 3:       # len() of a static tuple
+        x = x[0]
+    scale = float(1e-6)        # literal cast, untainted
+    return x * scale
+
+def launcher(fn, xs):
+    # value-dependent python flow OUTSIDE any jit scope
+    while xs[0] < 10:
+        xs = fn(xs)
+    return xs
+'''
+
+
+def _rules(violations):
+    return sorted(set(v.rule for v in violations))
+
+
+def test_known_bad_source_trips_every_rule():
+    vs = lint_source(BAD_SOURCE, "bad.py")
+    assert _rules(vs) == ["H101", "H102", "H103", "H104", "H105"]
+    # nested scan body is jit-scoped through the lexical chain
+    assert any(v.qualname == "outer.body" and v.rule == "H101"
+               for v in vs)
+    # two H104s: the if and the while
+    assert sum(1 for v in vs if v.rule == "H104") == 2
+
+
+def test_known_clean_source_is_unflagged():
+    assert lint_source(CLEAN_SOURCE, "clean.py") == []
+
+
+def test_to_static_counts_as_jit_scope():
+    src = '''
+import paddle
+@paddle.jit.to_static
+def fwd(x):
+    if x.sum() > 0:
+        return x
+    return -x
+'''
+    vs = lint_source(src, "m.py")
+    assert [v.rule for v in vs] == ["H104"]
+
+
+def test_partial_jit_decorator_counts():
+    src = '''
+from functools import partial
+import jax
+@partial(jax.jit, static_argnums=(1,))
+def f(x, n):
+    return x.item()
+'''
+    vs = lint_source(src, "m.py")
+    assert [v.rule for v in vs] == ["H101"]
+
+
+def test_allowlist_roundtrip(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "# comment\n"
+        "src/bad.py:H102:step  # temperature-style static cast, verified\n")
+    entries = load_allowlist(str(allow))
+    assert entries == {
+        "src/bad.py:H102:step": "temperature-style static cast, verified"}
+
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    (src_dir / "bad.py").write_text(BAD_SOURCE)
+    vs, unused = lint_paths([str(src_dir / "bad.py")], entries,
+                            root=str(tmp_path))
+    assert not any(v.rule == "H102" for v in vs)  # suppressed
+    assert any(v.rule == "H101" for v in vs)      # others still fire
+    assert unused == []
+
+    # a stale entry is surfaced
+    entries["src/bad.py:H102:gone"] = "obsolete"
+    _, unused = lint_paths([str(src_dir / "bad.py")], entries,
+                           root=str(tmp_path))
+    assert unused == ["src/bad.py:H102:gone"]
+
+
+def test_allowlist_requires_justification(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("bad.py:H102:step\n")
+    with pytest.raises(ValueError, match="justification"):
+        load_allowlist(str(allow))
+
+
+# ------------------------------------------------------------ repo gate
+
+def test_repo_source_is_tracer_hazard_free():
+    """Tier-1 gate: `paddle_tpu/` must lint clean modulo the checked-in
+    allowlist, and the allowlist must carry no stale entries."""
+    allow = (load_allowlist(DEFAULT_ALLOWLIST)
+             if os.path.exists(DEFAULT_ALLOWLIST) else {})
+    violations, unused = lint_paths(
+        [os.path.join(REPO, "paddle_tpu")], allow, root=REPO)
+    assert not violations, (
+        "new tracer hazards in framework source (fix them or add a "
+        "JUSTIFIED allowlist entry):\n  "
+        + "\n  ".join(repr(v) for v in violations))
+    assert not unused, f"stale allowlist entries: {unused}"
+
+
+@pytest.mark.parametrize("extra", [[], ["--strict-allowlist"]])
+def test_lint_cli_exits_zero_on_repo(extra):
+    """The acceptance-criteria contract:
+    `python -m paddle_tpu.analysis.lint paddle_tpu/` exits 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis.lint",
+         "paddle_tpu/"] + extra,
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tracer hazard" in proc.stderr

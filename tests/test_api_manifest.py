@@ -20,6 +20,8 @@ NAMESPACES = {
     "fft.txt": lambda: paddle.fft,
     "sparse.txt": lambda: paddle.sparse,
     "incubate_functional.txt": lambda: paddle.incubate.nn.functional,
+    "analysis.txt": lambda: __import__(
+        "paddle_tpu.analysis", fromlist=["analysis"]),
 }
 
 

@@ -641,3 +641,62 @@ def test_speculative_greedy_matches_target_greedy():
         speculative_greedy_search(
             target, draft,
             paddle.to_tensor(np.zeros((2, 4), np.int32)), 4)
+
+
+def test_speculative_full_accept_keeps_draft_cache_complete():
+    """ADVICE round-5 medium: after a FULL-accept round (a == g) the
+    draft must still consume props[g-1] — without the extra forward the
+    slot at pos+g stays stale forever and every later draft forward
+    attends a hole in the accepted history. A recording proxy around
+    the draft asserts every generated position < the final draft write
+    position was fed exactly the emitted token."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import speculative_greedy_search
+
+    paddle.seed(0)
+    target = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+    target.eval()
+
+    class RecordingDraft:
+        """Wraps the draft model; records (token, position) per
+        single-token forward."""
+
+        def __init__(self, m):
+            self._m = m
+            self.writes = {}  # position -> last token fed there
+
+        @property
+        def config(self):
+            return self._m.config
+
+        def init_caches(self, *a, **kw):
+            return self._m.init_caches(*a, **kw)
+
+        def __call__(self, ids, caches=None, position_offset=0):
+            arr = np.asarray(ids._value)
+            for j in range(arr.shape[1]):
+                self.writes[int(position_offset) + j] = int(arr[0, j])
+            return self._m(ids, caches=caches,
+                           position_offset=position_offset)
+
+    # draft == target maximizes full-accept rounds (the bug's trigger)
+    draft = RecordingDraft(target)
+    ids = paddle.to_tensor(np.random.RandomState(5).randint(0, 128, (1, 7)))
+    new = 9
+    out, rate = speculative_greedy_search(target, draft, ids,
+                                          max_new_tokens=new, gamma=3)
+    assert rate > 0.5  # the scenario really exercised full accepts
+    toks = [int(t) for t in out.numpy()[0]]
+
+    # the draft cache must hold the COMPLETE accepted history: every
+    # position from the prompt end up to its last write was fed, and
+    # fed the token the search actually emitted at that position
+    s_in = ids.shape[1]
+    last = max(p for p in draft.writes if p >= s_in)
+    missing = [p for p in range(s_in, last + 1)
+               if p not in draft.writes]
+    assert not missing, f"stale draft-KV slots at positions {missing}"
+    wrong = {p: (draft.writes[p], toks[p])
+             for p in range(s_in, min(last + 1, len(toks)))
+             if draft.writes[p] != toks[p]}
+    assert not wrong, f"draft cache tokens diverge from emitted: {wrong}"

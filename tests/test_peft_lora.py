@@ -157,3 +157,21 @@ def test_lora_trainable_bias_scoped_to_wrapped_layers():
     sd = lora_state_dict(lora)
     assert any(k.endswith(".bias") for k in sd)
     assert all("lora_" in k or ".base." in k for k in sd)
+
+
+def test_lora_a_init_variance_is_one_over_r():
+    """ADVICE round-5 low: A ~ N(0, 1/r) means std = sqrt(1/r), not
+    1/r — with std=1/r the adapter update scale shrank quadratically in
+    the rank. Estimate the sample std over a wide layer."""
+    paddle.seed(7)
+    r = 16
+    base = paddle.nn.Linear(512, 64)
+    lora = LoRALinear(base, r=r, lora_alpha=32)
+    a = np.asarray(lora.lora_A._value)
+    assert a.shape == (512, r)
+    expected = (1.0 / r) ** 0.5
+    sample = a.std()
+    # 512*16 samples: std estimate within ±10% of sqrt(1/r), and an
+    # order of magnitude away from the buggy 1/r
+    assert abs(sample - expected) < 0.1 * expected, (sample, expected)
+    assert sample > 2.0 * (1.0 / r)
