@@ -117,6 +117,7 @@ from .hapi.summary import summary, flops  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import nlp  # noqa: E402
+from . import serving  # noqa: E402
 from . import profiler  # noqa: E402
 from . import fft  # noqa: E402
 from . import quantization  # noqa: E402

@@ -10,7 +10,9 @@ Three layers:
    (:func:`collective_census`), involuntary-remat detection
    (:func:`detect_involuntary_remat`), dtype-promotion audit
    (:func:`audit_dtype_promotion`), buffer-donation audit
-   (:func:`audit_donation`) — all run at once by :func:`audit`.
+   (:func:`audit_donation`), host-sync census
+   (:func:`host_sync_census` — python callbacks / infeed / outfeed in
+   the compiled module) — all run at once by :func:`audit`.
 2. **Budgets**: :class:`Budget` + :func:`check_budget` enforce
    declarative per-recipe expectations ("0 remat fallbacks, <=N
    all-gathers, 0 f32 matmuls, everything donated"); the real recipes
@@ -30,6 +32,7 @@ from .collectives import (
 from .remat import RematEvent, detect_involuntary_remat
 from .dtypes import DtypeReport, F32ComputeEvent, audit_dtype_promotion
 from .donation import ArgDonation, DonationReport, audit_donation
+from .hostsync import HostSyncStats, host_sync_census
 from .budget import (
     AuditReport, Budget, BudgetViolation, audit, check_budget,
 )
@@ -45,6 +48,7 @@ __all__ = [
     "reduce_scatter_pattern", "RematEvent", "detect_involuntary_remat",
     "DtypeReport", "F32ComputeEvent", "audit_dtype_promotion",
     "ArgDonation", "DonationReport", "audit_donation",
+    "HostSyncStats", "host_sync_census",
     # budgets
     "AuditReport", "Budget", "BudgetViolation", "audit", "check_budget",
     "RECIPES", "Recipe", "build_recipe", "run_recipe",

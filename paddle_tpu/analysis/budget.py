@@ -21,6 +21,7 @@ from .collectives import (
 from .remat import detect_involuntary_remat
 from .dtypes import audit_dtype_promotion, DtypeReport
 from .donation import audit_donation
+from .hostsync import host_sync_census
 
 __all__ = ["Budget", "BudgetViolation", "AuditReport", "audit",
            "check_budget"]
@@ -29,8 +30,8 @@ _BUDGET_FIELDS = (
     "max_remat", "max_all_gathers", "max_all_reduces",
     "max_reduce_scatters", "max_all_to_alls", "max_collective_permutes",
     "max_total_collectives", "max_collective_bytes", "max_f32_matmuls",
-    "max_f32_upcasts", "max_undonated_bytes", "require_donated",
-    "require_reduce_scatter", "require_all_gather",
+    "max_f32_upcasts", "max_undonated_bytes", "max_host_callbacks",
+    "require_donated", "require_reduce_scatter", "require_all_gather",
 )
 
 _KIND_FIELD = {
@@ -58,6 +59,9 @@ class Budget:
             values (0 = a bf16 graph stays bf16 on the MXU path).
         max_f32_upcasts: bf16/f16 -> f32 convert ops.
         max_undonated_bytes: bytes of donatable args left undonated.
+        max_host_callbacks: python-callback custom-calls plus
+            infeed/outfeed/host send-recv ops in the compiled module
+            (0 = the no-host-sync-inside-the-loop serving invariant).
     Requirements:
         require_donated: every donatable arg must be donated.
         require_reduce_scatter: the stage-2 ZeRO pattern (fused
@@ -103,7 +107,7 @@ class AuditReport:
     """Structured result of every pass over one compiled program."""
 
     def __init__(self, name, collectives, remat_events, dtype_report,
-                 donation):
+                 donation, host_sync=None):
         self.name = name
         #: dict kind -> CollectiveStats
         self.collectives = collectives
@@ -113,6 +117,8 @@ class AuditReport:
         self.dtype = dtype_report
         #: DonationReport
         self.donation = donation
+        #: HostSyncStats (callbacks + host transfers in compiled HLO)
+        self.host_sync = host_sync
 
     @property
     def total_collectives(self):
@@ -143,6 +149,11 @@ class AuditReport:
                 f"{self.dtype.upcasts}")
             for ev in self.dtype.f32_compute[:4]:
                 lines.append(f"    {ev!r}")
+        if self.host_sync is not None:
+            lines.append(
+                f"  host syncs: {self.host_sync.count} "
+                f"(callbacks {len(self.host_sync.callbacks)}, "
+                f"transfers {len(self.host_sync.transfers)})")
         d = self.donation
         lines.append(
             f"  donation: {d.donated_count}/{len(d.args)} args donated"
@@ -168,8 +179,9 @@ def audit(target, *args, **kwargs):
                     if jaxpr is not None else None)
     donation = audit_donation(lt.stablehlo_text(),
                               n_donatable=lt.n_donatable)
+    host_sync = host_sync_census(hlo)
     report = AuditReport(lt.name, census, remat_events, dtype_report,
-                         donation)
+                         donation, host_sync=host_sync)
     report.hlo_text = hlo  # kept for pattern checks (reduce-scatter)
     return report
 
@@ -204,6 +216,9 @@ def check_budget(target, budget, *args, **kwargs):
         v.append("dtype budget set but target offers no jaxpr to audit")
     cap(budget.max_undonated_bytes, report.donation.undonated_bytes,
         "undonated donatable bytes")
+    if report.host_sync is not None:
+        cap(budget.max_host_callbacks, report.host_sync.count,
+            "host callbacks/transfers in compiled module")
     if budget.require_donated:
         und = report.donation.undonated()
         if report.donation.n_donatable is None:

@@ -1,0 +1,61 @@
+"""Host-sync census over a compiled HLO module.
+
+A serving decode loop is only "one dispatch" if the compiled program
+never bounces through the host mid-flight. Two things break that
+invariant and both are visible in the compiled HLO text:
+
+- **python callbacks** — ``jax.pure_callback`` / ``io_callback`` /
+  ``jax.debug.callback`` (and ``jax.debug.print``) lower to
+  ``custom-call`` ops whose ``custom_call_target`` contains
+  ``callback`` (``xla_python_cpu_callback``,
+  ``xla_ffi_python_cpu_callback``, ...). Each one is a device→host→
+  device round trip per execution.
+- **host transfers** — ``infeed`` / ``outfeed`` / host ``send`` /
+  ``recv`` ops stall the step on the host queue.
+
+Kernel custom-calls (``tpu_custom_call`` for Pallas, cuDNN, ...) do NOT
+match: only targets naming a callback are flagged, so a paged-attention
+kernel keeps a clean census. The serving Budget pins
+``max_host_callbacks=0`` on the decode quantum — the "no per-token host
+sync" claim is machine-checked, not comment-checked.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["HostSyncStats", "host_sync_census"]
+
+# custom-call ops whose target names a python callback trampoline
+_CALLBACK_RE = re.compile(r'custom_call_target="([^"]*callback[^"]*)"')
+# host-transfer opcodes: after the `=` of an HLO instruction the shape
+# comes first, then the opcode immediately before `(`
+_TRANSFER_RE = re.compile(
+    r"=\s*[^=\n]*?\b(infeed|outfeed|send|send-done|recv|recv-done)\(")
+
+
+class HostSyncStats:
+    """Census result: ``callbacks`` is the list of callback custom-call
+    targets (one entry per op), ``transfers`` the list of host-transfer
+    opcodes found."""
+
+    __slots__ = ("callbacks", "transfers")
+
+    def __init__(self, callbacks, transfers):
+        self.callbacks = list(callbacks)
+        self.transfers = list(transfers)
+
+    @property
+    def count(self):
+        return len(self.callbacks) + len(self.transfers)
+
+    def __repr__(self):
+        return (f"HostSyncStats(callbacks={self.callbacks}, "
+                f"transfers={self.transfers})")
+
+
+def host_sync_census(hlo_text):
+    """Scan compiled HLO text for host round-trips; returns
+    :class:`HostSyncStats`."""
+    callbacks = _CALLBACK_RE.findall(hlo_text)
+    transfers = [m.group(1) for m in _TRANSFER_RE.finditer(hlo_text)]
+    return HostSyncStats(callbacks, transfers)

@@ -156,4 +156,8 @@ def lower_target(target, *args, **kwargs):
         # make_jaxpr traces through the pjit wrapper, so jitted and
         # plain callables share one path
         jaxpr_fn=lambda: jax.make_jaxpr(jitted)(*vals, **kw),
+        # a jitted target may declare how many LEADING args it donates
+        # (e.g. ServingEngine.decode_step_target's KV pool leaves) so
+        # require_donated budgets work beyond JittedTrainStep
+        n_donatable=getattr(target, "n_donatable", None),
     )

@@ -12,6 +12,12 @@ known-good graph shape.
   (one-dispatch serving shape) on a bf16 tiny llama. Budget: a
   single-chip program stays collective-free, and the bf16 graph stays
   bf16 — 0 f32 matmuls reachable from the bf16 params.
+- ``serving_decode_step``: the continuous-batching engine's jitted
+  decode quantum (``ServingEngine.decode_step_target`` — the EXACT
+  compiled program the serving hot loop dispatches, audited with the
+  engine's live post-prefill state). Budget: 0 involuntary remats, 0
+  host callbacks/transfers (the no-per-token-host-sync invariant), the
+  KV pool leaves all donated, collective-free, and bf16 stays bf16.
 
 ``build(name)`` constructs the recipe (installing the mesh it needs)
 and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
@@ -133,9 +139,37 @@ def _build_llama_decode_greedy():
     return Recipe("llama_decode_greedy", jitted, args, budget)
 
 
+def _build_serving_decode_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=4)
+    rng = np.random.RandomState(0)
+    engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
+                  max_new_tokens=8)
+    engine.step()  # admit + prefill so the audited state is live
+    target, args = engine.decode_step_target()
+    budget = Budget(
+        name="serving decode quantum (bf16, single chip)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip serving program
+        max_f32_matmuls=0,        # bf16 pool/params stay bf16
+        max_host_callbacks=0,     # host scheduler only at boundaries
+        require_donated=True,     # the 2L KV pool leaves
+    )
+    return Recipe("serving_decode_step", target, args, budget)
+
+
 RECIPES = {
     "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
     "llama_decode_greedy": _build_llama_decode_greedy,
+    "serving_decode_step": _build_serving_decode_step,
 }
 
 

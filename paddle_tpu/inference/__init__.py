@@ -141,3 +141,28 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_engine(model, **kwargs):
+    """Continuous-batching entry point next to ``create_predictor``:
+    wrap a causal LM in a :class:`~paddle_tpu.serving.ServingEngine`
+    (shared paged KV pool, chunked prefill, single-dispatch decode
+    quantum). Keyword args forward to the engine — num_slots,
+    block_size, decode_quantum, decode_strategy, eos_token_id, ...
+    See :mod:`paddle_tpu.serving`."""
+    from ..serving import ServingEngine
+
+    return ServingEngine(model, **kwargs)
+
+
+__all__.append("create_serving_engine")
+
+
+def __getattr__(name):
+    # lazy: serving imports the nlp tier, which loads after inference
+    # during package init
+    if name == "ServingEngine":
+        from ..serving import ServingEngine
+
+        return ServingEngine
+    raise AttributeError(name)

@@ -40,6 +40,8 @@ class PagedKVCachePool:
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id -> list[int] block ids
         self._lens: dict = {}     # seq_id -> int tokens
+        self._peak_blocks = 0     # high-water mark of blocks_in_use
+        self._freed_total = 0     # blocks returned over the pool's life
 
     # -- allocator ---------------------------------------------------------
     def ensure(self, seq_id, new_total_tokens):
@@ -52,13 +54,43 @@ class PagedKVCachePool:
                     f"KV pool exhausted ({self.num_blocks} blocks)")
             table.append(self._free.pop())
         self._lens[seq_id] = int(new_total_tokens)
+        self._peak_blocks = max(self._peak_blocks, self.blocks_in_use)
         return table
 
     def free(self, seq_id):
-        """Return a finished sequence's blocks to the pool."""
-        for blk in self._tables.pop(seq_id, []):
-            self._free.append(blk)
+        """Return a finished sequence's blocks to the pool (immediate
+        reuse: the free list is LIFO, so a retiring sequence's blocks go
+        straight to the next admission)."""
+        blocks = self._tables.pop(seq_id, [])
+        self._free.extend(blocks)
+        self._freed_total += len(blocks)
         self._lens.pop(seq_id, None)
+
+    def trim(self, seq_id, new_total_tokens):
+        """Shrink (realloc) a live sequence to ``new_total_tokens``,
+        releasing now-unused tail blocks — the speculative-decode
+        rollback / prefix-truncation path. Growing is ``ensure``'s job;
+        a trim above the current length is a no-op on the table."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            return []
+        keep = -(-int(new_total_tokens) // self.block_size)
+        released = table[keep:]
+        del table[keep:]
+        self._free.extend(released)
+        self._freed_total += len(released)
+        self._lens[seq_id] = min(self._lens.get(seq_id, 0),
+                                 int(new_total_tokens))
+        return released
+
+    def blocks_needed(self, total_tokens):
+        """Blocks a sequence of ``total_tokens`` occupies."""
+        return -(-int(total_tokens) // self.block_size)
+
+    def can_allocate(self, total_tokens):
+        """Admission-control check: could a NEW sequence of
+        ``total_tokens`` be allocated right now?"""
+        return self.blocks_needed(total_tokens) <= len(self._free)
 
     def seq_len(self, seq_id):
         return self._lens.get(seq_id, 0)
@@ -66,6 +98,30 @@ class PagedKVCachePool:
     @property
     def blocks_in_use(self):
         return self.num_blocks - len(self._free)
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def fragmentation_stats(self):
+        """Allocator health counters for the serving scheduler: the only
+        fragmentation a paged pool can have is INTERNAL (tail waste in
+        each sequence's last block) — blocks are unit-sized so external
+        fragmentation cannot occur. ``utilization`` is live tokens over
+        allocated token capacity (1.0 when every allocated slot holds a
+        live token)."""
+        live = sum(self._lens.get(s, 0) for s in self._tables)
+        cap = self.blocks_in_use * self.block_size
+        return {
+            "num_blocks": self.num_blocks,
+            "blocks_in_use": self.blocks_in_use,
+            "free_blocks": len(self._free),
+            "peak_blocks_in_use": self._peak_blocks,
+            "blocks_freed_total": self._freed_total,
+            "live_tokens": live,
+            "tail_waste_tokens": cap - live,
+            "utilization": (live / cap) if cap else 1.0,
+        }
 
     def bytes_in_use(self):
         """Live cache bytes — the paged-cache memory claim: scales with

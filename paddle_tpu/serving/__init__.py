@@ -1,0 +1,17 @@
+"""paddle_tpu.serving — continuous-batching inference over the paged
+KV pool (reference: the 2.6-era serving loop around AnalysisPredictor /
+``Predictor.run`` and the blocked-cache predictor — SURVEY.md §0/§2.6/
+§3.5).
+
+:class:`ServingEngine` multiplexes many in-flight requests over one
+shared :class:`~paddle_tpu.nlp.paged_cache.PagedKVCachePool` and one
+single-dispatch jitted decode step; :mod:`.scheduler` holds the
+admission queue, slot table, and block accounting. The decode step's
+compiled graph is pinned by the ``serving_decode_step`` analysis Budget
+(zero involuntary remat, zero host callbacks, KV pools donated).
+Benched by ``scripts/bench_serving.py`` (ragged Poisson arrivals).
+"""
+from .scheduler import Request, Scheduler, SchedulerConfig
+from .engine import ServingEngine
+
+__all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine"]

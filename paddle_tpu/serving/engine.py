@@ -1,0 +1,579 @@
+"""Continuous-batching serving engine over the paged KV pool.
+
+The reference's serving story is the decode HOT LOOP that admits and
+retires ragged requests against a shared KV cache (AnalysisPredictor /
+``Predictor.run`` -> fused_multi_transformer, SURVEY.md §2.6/§3.5;
+the blocked-cache serving predictor is unverified, SURVEY §0). The TPU
+shape of that loop:
+
+- **fixed-capacity slot batch**: the decode step is compiled ONCE for
+  ``num_slots`` rows (the padded active set). Requests occupy slots;
+  empty/finished slots ride along masked. No recompiles as traffic
+  ebbs and flows.
+- **single-dispatch decode quantum**: ``decode_quantum`` tokens for
+  every live slot run inside ONE jitted program — a ``lax.scan`` of
+  single-token steps over the shared
+  :class:`~paddle_tpu.nlp.paged_cache.PagedKVCachePool`, with
+  eos/max-len retirement masks computed ON DEVICE and the pool buffers
+  donated (audited by the ``serving_decode_step`` analysis Budget: zero
+  involuntary remat, zero host callbacks, pools donated). The host
+  scheduler runs only at quantum boundaries.
+- **chunked prefill interleaved with decode**: new arrivals push their
+  prompt through ``block_multihead_attention`` in ``prefill_chunk``-
+  token slices, sharing MIXED batches with the in-flight slots' decode
+  rows — admission never stalls the running requests.
+- **block accounting**: retirement returns blocks to the pool free
+  list for immediate reuse; admission is gated on worst-case demand so
+  the pool cannot exhaust mid-flight (scheduler.py).
+
+Token selection reuses the generation tier's ``_filter_logits``
+(greedy argmax or temperature/top-k/top-p sampling with per-slot key
+fold-in); the greedy arm is oracle-tested bit-exact against
+per-request sequential ``generate`` (tests/test_serving.py).
+"""
+from __future__ import annotations
+
+import math
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..jit import functional_call
+from ..nlp.generation import _filter_logits
+from ..nlp.paged_cache import PagedKVCachePool
+from .scheduler import Request, Scheduler, SchedulerConfig
+
+__all__ = ["ServingEngine"]
+
+
+def _rope_rows(x, cos, sin):
+    """Rotate (S, H, D) by per-row angles (S, D/2) — the model's
+    default (neox) rotary layout at each slot's own cache position."""
+    xf = x.astype(jnp.float32)
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    d = x.shape[-1]
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _xla_paged_decode_attn(q, kp, vp, tables, lens):
+    """Off-TPU decode attention over the paged pool: gather the table's
+    blocks and run the same f32 masked softmax as the contiguous-cache
+    fallback (`_masked_decode_attn`)."""
+    s_, h, d = q.shape
+    w = tables.shape[1]
+    bs, hk = kp.shape[1], kp.shape[2]
+    k = kp[tables].reshape(s_, w * bs, hk, d)
+    v = vp[tables].reshape(s_, w * bs, hk, d)
+    rep = h // hk
+    kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+    vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+    sc = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                        kr.astype(jnp.float32)) * sc
+    mask = jnp.arange(w * bs)[None, :] < lens[:, None]
+    logits = jnp.where(mask[:, None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, vr.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_attn(q, kp, vp, tables, lens):
+    """Route decode attention: Pallas paged kernel on TPU (block tables
+    dereferenced in SMEM, one pool block DMA per grid step), XLA gather
+    fallback elsewhere."""
+    from ..core.flags import get_flags
+
+    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
+    use_pallas = flags["FLAGS_use_pallas_kernels"] and (
+        jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
+    if use_pallas:
+        from ..ops.pallas.paged_attention import paged_decode_attention
+
+        return paged_decode_attention(q, kp, vp, tables, lens)
+    return _xla_paged_decode_attn(q, kp, vp, tables, lens)
+
+
+class _AuditedStep:
+    """Callable+lowerable wrapper handed to ``analysis.check_budget``:
+    declares how many LEADING flat args the quantum donates (the 2L KV
+    pool leaves) so ``require_donated`` audits the right set."""
+
+    def __init__(self, jitted, n_donatable):
+        self._jitted = jitted
+        self.n_donatable = int(n_donatable)
+        self.__name__ = "serving_decode_quantum"
+
+    def __call__(self, *args):
+        return self._jitted(*args)
+
+    def lower(self, *args):
+        return self._jitted.lower(*args)
+
+
+class ServingEngine:
+    """Multiplex many in-flight generation requests over one shared
+    paged KV pool and one jitted decode step.
+
+    Args:
+        model: a LlamaForCausalLM-shaped causal LM (eval mode; params
+            define the cache dtype).
+        num_slots: fixed decode batch capacity (padded active set).
+        block_size: KV pool block size in tokens.
+        num_blocks: pool capacity; default sizes the pool for
+            ``num_slots`` full-context sequences plus the scratch block.
+        max_context: per-request prompt+generation bound (defaults to
+            the model's max_position_embeddings).
+        prefill_chunk / decode_quantum: see SchedulerConfig.
+        decode_strategy: "greedy" | "sampling" (engine-wide; sampling
+            knobs via top_k/top_p/temperature, per-request seeds).
+        eos_token_id: retire a slot the step after it emits this id.
+    """
+
+    def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
+                 max_context=None, prefill_chunk=64, decode_quantum=8,
+                 decode_strategy="greedy", top_k=0, top_p=1.0,
+                 temperature=1.0, eos_token_id=None):
+        cfg = model.config
+        if getattr(cfg, "sliding_window", None):
+            raise NotImplementedError(
+                "ServingEngine does not compose with sliding_window: a "
+                "rolling buffer wrap-writes over pool slots the block "
+                "tables still map")
+        if decode_strategy not in ("greedy", "sampling"):
+            raise ValueError(
+                f"decode_strategy must be greedy|sampling, got "
+                f"{decode_strategy!r}")
+        self.model = model
+        model.eval()
+        self.config = SchedulerConfig(num_slots=num_slots,
+                                      prefill_chunk=prefill_chunk,
+                                      decode_quantum=decode_quantum)
+        self.decode_strategy = decode_strategy
+        self.top_k = 0 if top_k is None else int(top_k)
+        self.top_p = 1.0 if top_p is None else float(top_p)
+        self.temperature = 1.0 if temperature is None else float(temperature)
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+
+        self.max_context = int(max_context
+                               or cfg.max_position_embeddings)
+        self._p_vals = [p._value for _, p in model.named_parameters()]
+        cache_dtype = self._p_vals[0].dtype
+        s = self.config.num_slots
+        bs = int(block_size)
+        w = -(-self.max_context // bs)
+        if num_blocks is None:
+            num_blocks = s * w + 1  # +1: the masked-write scratch block
+        self.pool = PagedKVCachePool(
+            num_blocks, bs, cfg.num_key_value_heads, cfg.head_dim,
+            num_layers=cfg.num_hidden_layers, dtype=cache_dtype)
+        # masked (retired/empty) rows dump their KV writes here
+        self._scratch_block = self.pool.ensure("__scratch__", 1)[0]
+        self.scheduler = Scheduler(self.config, self.pool,
+                                   reserved_blocks=1)
+        self._table_width = w
+
+        # host mirrors of the per-slot device state
+        self._tables = np.zeros((s, w), np.int32)
+        self._seq_lens = np.zeros(s, np.int32)
+        self._last_tok = np.zeros(s, np.int32)
+        self._n_gen = np.zeros(s, np.int32)
+        self._done = np.ones(s, bool)
+        self._max_new = np.zeros(s, np.int32)
+        self._keys = np.zeros((s, 2), np.uint32)
+
+        # rotary table shared by prefill (block_mha fused rope) and the
+        # quantum (per-row angles recomputed on device)
+        from ..nn.functional.rope import build_rope_cache
+
+        cos, sin = build_rope_cache(self.max_context, cfg.head_dim,
+                                    base=cfg.rope_theta)
+        self._rotary = Tensor(jnp.stack([cos, sin]), stop_gradient=True)
+
+        self._quantum = jax.jit(self._make_quantum(),
+                                donate_argnums=(0, 1))
+        self._audited = _AuditedStep(
+            self._quantum, n_donatable=2 * cfg.num_hidden_layers)
+        self.completed: list = []
+        self.stats = {"steps": 0, "mixed_steps": 0, "decode_quanta": 0,
+                      "quantum_tokens": 0, "prefill_tokens": 0,
+                      "generated_tokens": 0, "occupancy_sum": 0.0}
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, req_id=None, seed=0,
+               arrival_time=None):
+        """Queue one request; returns the :class:`Request` handle."""
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      req_id=req_id, seed=seed,
+                      arrival_time=(time.perf_counter()
+                                    if arrival_time is None
+                                    else arrival_time))
+        total = req.prompt_len + req.max_new_tokens
+        if total > self.max_context:
+            raise ValueError(
+                f"request needs {total} tokens > max_context "
+                f"{self.max_context}")
+        return self.scheduler.submit(req)
+
+    @property
+    def has_work(self):
+        return self.scheduler.has_work
+
+    def step(self):
+        """One scheduler iteration: admit, then either a mixed
+        prefill(+decode) step or a jitted decode quantum, then retire."""
+        self.stats["steps"] += 1
+        self._admit()
+        live = self.scheduler.live()
+        self.stats["occupancy_sum"] += (
+            len(live) / self.config.num_slots)
+        if self.scheduler.prefilling():
+            self._mixed_step()
+        elif self.scheduler.decoding():
+            self._decode_quantum()
+        return self.scheduler.has_work
+
+    def run(self, requests=None):
+        """Submit ``requests`` (if given) and drive until idle; returns
+        the completed :class:`Request` list in submission order."""
+        if requests is not None:
+            for r in requests:
+                if isinstance(r, Request):
+                    self.scheduler.submit(r)
+                elif isinstance(r, dict):
+                    self.submit(**r)
+                else:
+                    self.submit(r)
+        while self.step():
+            pass
+        return self.completed
+
+    def output_tokens(self, req):
+        """prompt + generated ids as one int32 array (generate()-style
+        row, truncated at retirement rather than pad-filled)."""
+        return np.concatenate([req.prompt,
+                               np.asarray(req.tokens, np.int32)])
+
+    def engine_stats(self):
+        out = dict(self.stats)
+        out["pool"] = self.pool.fragmentation_stats()
+        out["admitted"] = self.scheduler.admitted_total
+        out["finished"] = self.scheduler.finished_total
+        if self.stats["steps"]:
+            out["mean_occupancy"] = (self.stats["occupancy_sum"]
+                                     / self.stats["steps"])
+        return out
+
+    def decode_step_target(self):
+        """(auditable step, example args) for ``analysis.check_budget``
+        — the EXACT compiled object the serving hot loop dispatches,
+        with the engine's live state as the example batch."""
+        return self._audited, self._quantum_args()
+
+    # -- admission + prefill ----------------------------------------------
+    def _admit(self):
+        now = time.perf_counter()
+        for req in self.scheduler.try_admit():
+            req.admit_time = now
+            slot = req.slot
+            self._seq_lens[slot] = 0
+            self._n_gen[slot] = 0
+            self._done[slot] = True  # not decodable until prefill ends
+            self._max_new[slot] = req.max_new_tokens
+            self._keys[slot] = np.asarray(jax.random.PRNGKey(req.seed))
+
+    def _mixed_step(self):
+        """One chunk of prefill for every prefilling slot, one decode
+        token for every in-flight slot — a single MIXED batch through
+        ``block_multihead_attention`` per layer (chunked prefill
+        interleaved with decode, the reference's serving batch shape)."""
+        import paddle_tpu as paddle
+        from ..incubate.nn.functional import block_multihead_attention
+
+        self.stats["mixed_steps"] += 1
+        model, cfg = self.model, self.model.config
+        chunk = self.config.prefill_chunk
+        pre = self.scheduler.prefilling()
+        dec = self.scheduler.decoding()
+        rows = pre + dec
+        toks, this_time, enc_lens, dec_lens = [], [], [], []
+        for req in pre:
+            n = min(chunk, req.prompt_len - req.prefill_pos)
+            toks.append(req.prompt[req.prefill_pos:req.prefill_pos + n])
+            this_time.append(n)
+            enc_lens.append(n)
+            dec_lens.append(req.prefill_pos)
+            self.pool.ensure(req.req_id, req.prefill_pos + n)
+        for req in dec:
+            slot = req.slot
+            toks.append(np.asarray([self._last_tok[slot]], np.int32))
+            this_time.append(1)
+            enc_lens.append(0)
+            dec_lens.append(int(self._seq_lens[slot]))
+            self.pool.ensure(req.req_id, int(self._seq_lens[slot]) + 1)
+        ids = np.concatenate(toks).astype(np.int32)
+        total = int(ids.shape[0])
+        self.stats["prefill_tokens"] += int(sum(enc_lens))
+        cu = np.concatenate([[0], np.cumsum(this_time)]).astype(np.int32)
+        tables = self.pool.block_table_array(
+            [r.req_id for r in rows], pad_to=self._table_width)
+
+        h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        kc_t = [Tensor(self.pool.k_pools[i], stop_gradient=True)
+                for i in range(cfg.num_hidden_layers)]
+        vc_t = [Tensor(self.pool.v_pools[i], stop_gradient=True)
+                for i in range(cfg.num_hidden_layers)]
+        common = dict(
+            seq_lens_encoder=paddle.to_tensor(
+                np.asarray(enc_lens, np.int32)),
+            seq_lens_decoder=paddle.to_tensor(
+                np.asarray(dec_lens, np.int32)),
+            seq_lens_this_time=paddle.to_tensor(
+                np.asarray(this_time, np.int32)),
+            block_tables=Tensor(tables, stop_gradient=True),
+            rotary_embs=self._rotary,
+            use_neox_rotary_style=True,  # the model's rope layout
+            num_heads=h, kv_num_heads=hk, head_dim=d,
+        )
+        with autograd.no_grad():
+            core = model.llama
+            hidden = core.embed_tokens(
+                paddle.to_tensor(ids[None, :]))          # (1, T, E)
+            for i, layer in enumerate(core.layers):
+                attn = layer.self_attn
+                residual = hidden
+                x = layer.input_layernorm(hidden)
+                q = attn.q_proj(x)
+                k = attn.k_proj(x)
+                v = attn.v_proj(x)
+                qkv = paddle.concat([q, k, v], axis=-1) \
+                    .reshape([total, (h + 2 * hk) * d])
+                att = block_multihead_attention(
+                    qkv, kc_t[i], vc_t[i], **common)
+                att3 = att.reshape([1, total, h * d])
+                hidden = residual + attn.o_proj(att3)
+                hidden = hidden + layer.mlp(
+                    layer.post_attention_layernorm(hidden))
+            hidden = core.norm(hidden)
+        # the mutated pool Tensors are the new truth
+        for i in range(cfg.num_hidden_layers):
+            self.pool.k_pools[i] = kc_t[i]._value
+            self.pool.v_pools[i] = vc_t[i]._value
+
+        # logits only where a next token is due: rows completing their
+        # prefill this chunk, and every decode row
+        need = [i for i, req in enumerate(rows)
+                if (i >= len(pre)) or
+                (req.prefill_pos + this_time[i] >= req.prompt_len)]
+        if need:
+            last_idx = np.asarray([cu[i + 1] - 1 for i in need], np.int32)
+            with autograd.no_grad():
+                hs = Tensor(hidden._value[0, last_idx],
+                            stop_gradient=True)
+                logits = model.lm_head(hs)._value        # (R, V)
+            nxt = self._select_host(logits,
+                                    [rows[i] for i in need])
+        now = time.perf_counter()
+        for i, req in enumerate(rows):
+            slot = req.slot
+            if i < len(pre):
+                req.prefill_pos += this_time[i]
+                self._seq_lens[slot] = req.prefill_pos
+                if req.prefill_pos >= req.prompt_len:
+                    tok = int(nxt[need.index(i)])
+                    req.first_token_time = now
+                    req.record(tok, self.eos_token_id)
+                    self._record_host(slot, req, tok)
+            else:
+                tok = int(nxt[need.index(i)])
+                self._seq_lens[slot] += 1  # last_tok entered the cache
+                req.record(tok, self.eos_token_id)
+                self._record_host(slot, req, tok)
+        self._retire_finished()
+
+    def _record_host(self, slot, req, tok):
+        self._last_tok[slot] = tok
+        self._n_gen[slot] = len(req.tokens)
+        self._done[slot] = req.finished
+
+    def _select_host(self, logits, rows):
+        """First-token / mixed-step selection with the SAME math as the
+        device quantum: argmax, or filtered categorical keyed by each
+        slot's fold_in(key, n_emitted)."""
+        if self.decode_strategy == "greedy":
+            return np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        filt = _filter_logits(logits, self.top_k, self.top_p,
+                              self.temperature)
+        keys = jnp.asarray(np.stack(
+            [self._keys[r.slot] for r in rows]))
+        steps = jnp.asarray(np.asarray(
+            [len(r.tokens) for r in rows], np.int32))
+        keys = jax.vmap(jax.random.fold_in)(keys, steps)
+        samp = jax.vmap(jax.random.categorical)(keys, filt)
+        return np.asarray(samp).astype(np.int32)
+
+    # -- the jitted decode quantum ----------------------------------------
+    def _select_device(self, logits, keys, n_gen):
+        if self.decode_strategy == "greedy":
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        filt = _filter_logits(logits, self.top_k, self.top_p,
+                              self.temperature)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, n_gen)
+        return jax.vmap(jax.random.categorical)(
+            step_keys, filt).astype(jnp.int32)
+
+    def _paged_decode_math(self, ids_t, seq_lens, tables, kc, vc, live):
+        """One token for every slot over the paged pool (the quantum's
+        per-step body; mirrors generation._manual_decode with block-table
+        writes instead of dense-cache slice updates)."""
+        model, cfg = self.model, self.model.config
+        core = model.llama
+        s = ids_t.shape[0]
+        h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        bs = self.pool.block_size
+        w = tables.shape[1]
+
+        hidden = core.embed_tokens(ids_t)                # (S, 1, E)
+        inv_freq = 1.0 / (cfg.rope_theta ** (
+            jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        pos = seq_lens.astype(jnp.float32)
+        freqs = pos[:, None] * inv_freq[None, :]
+        cos, sin = jnp.cos(freqs), jnp.sin(freqs)        # (S, D/2)
+
+        blk_idx = jnp.clip(seq_lens // bs, 0, w - 1)
+        own_blk = jnp.take_along_axis(tables, blk_idx[:, None],
+                                      axis=1)[:, 0]
+        write_blk = jnp.where(live, own_blk, self._scratch_block)
+        write_off = jnp.where(live, seq_lens % bs, 0)
+        lens = jnp.where(live, seq_lens + 1, 1)
+
+        new_kc, new_vc = [], []
+        for i, layer in enumerate(core.layers):
+            attn = layer.self_attn
+            residual = hidden
+            x = layer.input_layernorm(hidden)
+            q = attn.q_proj(x).reshape([s, 1, h, d])
+            k = attn.k_proj(x).reshape([s, 1, hk, d])
+            v = attn.v_proj(x).reshape([s, 1, hk, d])
+            qv = _rope_rows(q._value[:, 0], cos, sin)    # (S, H, D)
+            kv = _rope_rows(k._value[:, 0], cos, sin)
+            kci = kc[i].at[write_blk, write_off].set(
+                kv.astype(kc[i].dtype))
+            vci = vc[i].at[write_blk, write_off].set(
+                v._value[:, 0].astype(vc[i].dtype))
+            new_kc.append(kci)
+            new_vc.append(vci)
+            att = _paged_attn(qv, kci, vci, tables, lens)
+            att_t = Tensor(att.reshape(s, 1, h * d), stop_gradient=True)
+            hidden = residual + attn.o_proj(att_t)
+            hidden = hidden + layer.mlp(
+                layer.post_attention_layernorm(hidden))
+        hidden = core.norm(hidden)
+        logits = model.lm_head(hidden)
+        return logits._value[:, 0], new_kc, new_vc
+
+    def _make_quantum(self):
+        model = self.model
+        t_steps = self.config.decode_quantum
+        has_eos = self.eos_token_id is not None
+        eos = -1 if self.eos_token_id is None else int(self.eos_token_id)
+
+        def quantum(kc, vc, p_vals, tables, seq_lens, last_tok, n_gen,
+                    done, max_new, keys):
+            def body(carry, _):
+                kc, vc, seq_lens, last_tok, n_gen, done = carry
+                live = ~done
+                with autograd.no_grad():
+                    def fwd(tok_t):
+                        return self._paged_decode_math(
+                            tok_t, seq_lens, tables, kc, vc, live)
+
+                    (logits, kc2, vc2), _ = functional_call(
+                        model, fwd,
+                        [Tensor(last_tok[:, None], stop_gradient=True)],
+                        {}, p_vals, [])
+                nxt = self._select_device(logits, keys, n_gen)
+                nxt = jnp.where(done, last_tok, nxt).astype(jnp.int32)
+                n_gen2 = n_gen + live.astype(jnp.int32)
+                done2 = done | (n_gen2 >= max_new)
+                if has_eos:
+                    done2 = done2 | (live & (nxt == eos))
+                seq_lens2 = seq_lens + live.astype(jnp.int32)
+                return (kc2, vc2, seq_lens2, nxt, n_gen2, done2), nxt
+
+            (kc, vc, seq_lens, last_tok, n_gen, done), toks = \
+                jax.lax.scan(
+                    body, (kc, vc, seq_lens, last_tok, n_gen, done),
+                    None, length=t_steps)
+            return kc, vc, seq_lens, last_tok, n_gen, done, toks
+
+        return quantum
+
+    def _quantum_args(self):
+        return (list(self.pool.k_pools), list(self.pool.v_pools),
+                self._p_vals, jnp.asarray(self._tables),
+                jnp.asarray(self._seq_lens),
+                jnp.asarray(self._last_tok), jnp.asarray(self._n_gen),
+                jnp.asarray(self._done), jnp.asarray(self._max_new),
+                jnp.asarray(self._keys))
+
+    def _decode_quantum(self):
+        """Dispatch one jitted quantum; the single host sync per
+        ``decode_quantum`` tokens happens HERE, at the admit/retire
+        boundary, never inside the compiled loop."""
+        self.stats["decode_quanta"] += 1
+        t_steps = self.config.decode_quantum
+        # grow each live slot's block table to cover the quantum before
+        # entering the device loop (tables are static inside)
+        for req in self.scheduler.decoding():
+            slot = req.slot
+            cap = req.prompt_len + req.max_new_tokens - 1
+            need = min(int(self._seq_lens[slot]) + t_steps, cap)
+            if need > self.pool.seq_len(req.req_id):
+                self.pool.ensure(req.req_id, need)
+            row = self.pool.block_table_array(
+                [req.req_id], pad_to=self._table_width)
+            self._tables[slot] = np.asarray(row)[0][:self._table_width]
+        kc, vc, seq_lens, last_tok, n_gen, done, toks = self._quantum(
+            *self._quantum_args())
+        self.pool.k_pools = list(kc)
+        self.pool.v_pools = list(vc)
+        toks = np.asarray(toks)                          # (T, S) sync
+        self._seq_lens = np.asarray(seq_lens).copy()
+        self._last_tok = np.asarray(last_tok).copy()
+        self._n_gen = np.asarray(n_gen).copy()
+        self._done = np.asarray(done).copy()
+        self.stats["quantum_tokens"] += int(toks.shape[0]) * int(
+            toks.shape[1])
+        now = time.perf_counter()
+        for req in self.scheduler.decoding():
+            slot = req.slot
+            for k in range(toks.shape[0]):
+                if req.finished:
+                    break
+                req.record(int(toks[k, slot]), self.eos_token_id)
+            if req.finished:
+                req.finish_time = now
+        self._retire_finished()
+
+    def _retire_finished(self):
+        now = time.perf_counter()
+        for req in list(self.scheduler.live()):
+            if req.finished:
+                slot = req.slot
+                if req.finish_time is None:
+                    req.finish_time = now
+                self.stats["generated_tokens"] += len(req.tokens)
+                self._done[slot] = True
+                self._max_new[slot] = 0
+                self.scheduler.retire(req)
+                self.completed.append(req)

@@ -1,0 +1,182 @@
+"""Request state + admission scheduling for the continuous-batching
+engine (reference: the serving loop around AnalysisPredictor /
+``Predictor.run``'s fused_multi_transformer decode HOT LOOP — SURVEY.md
+§2.6/§3.5; the scheduler itself mirrors the 2.6-era
+BlockInferencePredictor's slot/block accounting — unverified, SURVEY §0).
+
+Pure host-side bookkeeping: a FIFO admission queue, a fixed table of
+``num_slots`` serving slots (the padded active set the jitted decode
+step is compiled for), and conservative block accounting against the
+shared :class:`~paddle_tpu.nlp.paged_cache.PagedKVCachePool` — a request
+is admitted only when its WORST-CASE block demand
+(``ceil((prompt + max_new) / block_size)``) fits under the pool capacity
+left unreserved by in-flight requests, so the pool can never exhaust
+mid-decode and no preemption path is needed. Retirement returns both the
+reservation and the actual blocks (``pool.free``) for immediate reuse.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+__all__ = ["Request", "SchedulerConfig", "Scheduler"]
+
+
+class Request:
+    """One generation request riding the engine.
+
+    Lifecycle: ``waiting`` (queued) -> ``prefill`` (admitted to a slot,
+    prompt entering the pool chunk by chunk) -> ``decode`` (in the
+    jitted quantum) -> ``finished`` (eos | max_new; blocks freed).
+    """
+
+    def __init__(self, prompt, max_new_tokens=32, req_id=None, seed=0,
+                 arrival_time=0.0):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.req_id = req_id
+        self.seed = int(seed)
+        self.arrival_time = float(arrival_time)
+        # mutable state
+        self.slot = None
+        self.prefill_pos = 0          # prompt tokens already in the pool
+        self.tokens: list = []        # generated token ids (incl. eos)
+        self.finished = False
+        self.finish_reason = None     # "eos" | "length"
+        self.admit_time = None
+        self.first_token_time = None
+        self.finish_time = None
+
+    @property
+    def prompt_len(self):
+        return int(self.prompt.shape[0])
+
+    @property
+    def prefilling(self):
+        return self.slot is not None and self.prefill_pos < self.prompt_len
+
+    @property
+    def decoding(self):
+        return (self.slot is not None and not self.finished
+                and self.prefill_pos >= self.prompt_len)
+
+    def record(self, token, eos_token_id=None):
+        """Append one emitted token and apply the retirement rule the
+        device mask uses (eos emitted, or max_new reached). Returns True
+        while the request stays live."""
+        if self.finished:
+            return False
+        self.tokens.append(int(token))
+        if eos_token_id is not None and int(token) == int(eos_token_id):
+            self.finished = True
+            self.finish_reason = "eos"
+        elif len(self.tokens) >= self.max_new_tokens:
+            self.finished = True
+            self.finish_reason = "length"
+        return not self.finished
+
+
+class SchedulerConfig:
+    """Engine/scheduler knobs.
+
+    num_slots: fixed capacity of the padded active set (the decode
+        quantum is compiled once for this batch).
+    prefill_chunk: max prompt tokens a new arrival pushes through the
+        mixed batch per step (chunked prefill keeps admission latency
+        bounded while in-flight slots keep decoding).
+    decode_quantum: decode steps per jitted dispatch; the host scheduler
+        only runs (admit/retire) at quantum boundaries.
+    """
+
+    def __init__(self, num_slots=8, prefill_chunk=64, decode_quantum=8):
+        self.num_slots = int(num_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.decode_quantum = int(decode_quantum)
+        if self.num_slots < 1 or self.prefill_chunk < 1 \
+                or self.decode_quantum < 1:
+            raise ValueError("all SchedulerConfig knobs must be >= 1")
+
+
+class Scheduler:
+    """Admission queue + slot table + block reservations."""
+
+    def __init__(self, config, pool, reserved_blocks=0):
+        self.config = config
+        self.pool = pool
+        self.waiting = deque()
+        self.slots = [None] * config.num_slots
+        # blocks permanently unavailable to requests (engine scratch)
+        self._base_reserved = int(reserved_blocks)
+        self._reservations = {}  # req -> worst-case block count
+        self.admitted_total = 0
+        self.finished_total = 0
+
+    # -- queue -------------------------------------------------------------
+    def submit(self, request):
+        if request.req_id is None:
+            request.req_id = f"req{self.admitted_total + len(self.waiting)}"
+        self.waiting.append(request)
+        return request
+
+    def _demand(self, req):
+        return self.pool.blocks_needed(req.prompt_len + req.max_new_tokens)
+
+    @property
+    def reserved_blocks(self):
+        return self._base_reserved + sum(self._reservations.values())
+
+    def try_admit(self):
+        """Move waiting requests into free slots while their worst-case
+        block demand fits; returns the newly admitted requests (FIFO —
+        a too-big head blocks the queue rather than starving)."""
+        admitted = []
+        while self.waiting:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                break
+            req = self.waiting[0]
+            need = self._demand(req)
+            if need > self.pool.num_blocks - self._base_reserved:
+                self.waiting.popleft()
+                raise ValueError(
+                    f"request {req.req_id}: needs {need} blocks, pool "
+                    f"only has {self.pool.num_blocks - self._base_reserved} "
+                    f"usable — raise num_blocks or split the request")
+            if self.reserved_blocks + need > self.pool.num_blocks:
+                break
+            self.waiting.popleft()
+            req.slot = free[0]
+            self.slots[free[0]] = req
+            self._reservations[req] = need
+            self.admitted_total += 1
+            admitted.append(req)
+        return admitted
+
+    def retire(self, req):
+        """Release a finished request's slot, reservation, and pool
+        blocks (free-list reuse is immediate)."""
+        self.pool.free(req.req_id)
+        self._reservations.pop(req, None)
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+        self.finished_total += 1
+
+    # -- views -------------------------------------------------------------
+    def live(self):
+        return [r for r in self.slots if r is not None]
+
+    def prefilling(self):
+        return [r for r in self.slots if r is not None and r.prefilling]
+
+    def decoding(self):
+        return [r for r in self.slots if r is not None and r.decoding]
+
+    @property
+    def has_work(self):
+        return bool(self.waiting) or any(s is not None for s in self.slots)

@@ -1,0 +1,335 @@
+"""Serving benches: continuous-batching throughput under ragged
+Poisson arrivals, and speculative decode vs the one-dispatch loop.
+
+    PYTHONPATH=. python scripts/bench_serving.py [serving_engine|speculative_decode ...]
+
+``serving_engine`` drives :class:`paddle_tpu.serving.ServingEngine` —
+many ragged requests (Poisson arrivals, log-ragged prompt/output
+lengths) multiplexed over one paged KV pool and one jitted decode
+quantum — and reports steady-state generated-token throughput against
+the sequential batch-1 ``generate_on_device`` baseline measured in the
+same process on the same model (the engine must win by keeping slots
+full while requests come and go; the arrival rate is set to ~2x the
+baseline's token rate so the queue stays non-empty and the measurement
+is capacity, not offered load). Off TPU the row reports under a
+``_cpu_smoke`` metric name (bench_suite convention) — the speedup
+ratio is still meaningful (batching amortizes per-dispatch overhead)
+but the tok/s is not a TPU claim.
+
+``speculative_decode`` closes round-5 VERDICT weak #1: tok/s and
+acceptance-rate-vs-speedup for ``speculative_greedy_search`` (self-
+draft: the target's own first layers as draft would need a trained
+head, so the draft here is a narrower random-init model — acceptance
+is then near-floor and the row records the WORST case; with
+acceptance=1 forced (draft=target) it records the best case. Both
+arms vs the ``generate_on_device`` single-dispatch loop at the same
+shape.)
+
+Both rows are registered in scripts/bench_suite.py (``serving_engine``,
+``speculative_decode``); results & methodology in BENCH_NOTES.md.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _serving_cfg():
+    """The 7B serving shape (llama_7b_shape_serving's stack: h4096/d128
+    GQA-32/8, L=4 layers fit one 16G chip) on TPU; tiny off-TPU."""
+    import jax
+    from paddle_tpu.nlp import LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=4, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            tensor_parallel=False)
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+    return cfg, on_tpu
+
+
+def _build_model(cfg, on_tpu):
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.astype("bfloat16")
+    model.eval()
+    return model
+
+
+def _request_set(cfg, on_tpu, rng):
+    """Ragged prompts/outputs: log-uniform lengths (short-head heavy,
+    like real traffic), fixed seed."""
+    if on_tpu:
+        n_req, p_lo, p_hi, n_lo, n_hi = 48, 32, 256, 32, 128
+    else:
+        n_req, p_lo, p_hi, n_lo, n_hi = 12, 4, 16, 6, 16
+    p_lens = np.exp(rng.uniform(np.log(p_lo), np.log(p_hi),
+                                n_req)).astype(int)
+    n_news = np.exp(rng.uniform(np.log(n_lo), np.log(n_hi),
+                                n_req)).astype(int)
+    return [(rng.randint(1, cfg.vocab_size, int(p)).astype(np.int32),
+             int(n)) for p, n in zip(p_lens, n_news)]
+
+
+def _seq_batch1_tok_s(model, cfg, on_tpu):
+    """The baseline the engine must beat: batch-1 sequential
+    ``generate_on_device`` at a fixed representative shape (one compile,
+    timed warm — the kindest possible sequential number)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.generation import generate_on_device
+
+    prompt, new = (128, 128) if on_tpu else (8, 8)
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(
+        1, cfg.vocab_size, (1, prompt)))
+
+    def run():
+        out = generate_on_device(model, ids, max_new_tokens=new)
+        np.asarray(out._value)
+
+    run()  # compile
+    best = float("inf")
+    for _ in range(5):  # min-of-5 rides out host-load noise
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return new / best
+
+
+def serving_engine():
+    """Continuous batching under ragged Poisson arrivals vs sequential
+    batch-1 decode — the tok/s-under-load number (ISSUE 2 tentpole)."""
+    from paddle_tpu.serving import ServingEngine
+
+    cfg, on_tpu = _serving_cfg()
+    model = _build_model(cfg, on_tpu)
+    rng = np.random.RandomState(0)
+    requests = _request_set(cfg, on_tpu, rng)
+
+    seq_tok_s = _seq_batch1_tok_s(model, cfg, on_tpu)
+    log(f"sequential batch-1 baseline: {seq_tok_s:.1f} tok/s")
+
+    num_slots = 8 if on_tpu else 16
+    block_size = 32 if on_tpu else 8
+    decode_quantum = 16 if on_tpu else 8
+    quanta = 6  # capacity-probe dispatches: 1 warm + 5 timed windows
+    probe_ctx = 8 + decode_quantum * quanta + 8
+    # size the pool's table width to the workload, not the model's
+    # absolute max: the XLA-gather fallback (and the pool itself) pay
+    # for table width, and a serving config always bounds context
+    max_ctx = max(max(p.shape[0] + n for p, n in requests), probe_ctx)
+    max_ctx = -(-max_ctx // block_size) * block_size
+    engine = ServingEngine(
+        model, num_slots=num_slots, block_size=block_size,
+        prefill_chunk=128 if on_tpu else 8,
+        decode_quantum=decode_quantum, max_context=max_ctx)
+
+    # warmup: compile the quantum + the mixed-step shapes on a clone of
+    # the request distribution, then reset the engine's counters
+    for p, n in requests[: num_slots + 2]:
+        engine.submit(p, max_new_tokens=n)
+    engine.run()
+    engine.completed.clear()
+    for k in engine.stats:
+        engine.stats[k] = 0
+    log("warmup done; timed ragged-arrival phase")
+
+    # open-loop Poisson arrivals at ~2x the baseline token rate: the
+    # queue stays non-empty, so throughput measures engine CAPACITY
+    mean_new = float(np.mean([n for _, n in requests]))
+    req_rate = 2.0 * seq_tok_s / mean_new  # requests/sec offered
+    gaps = rng.exponential(1.0 / req_rate, len(requests))
+    arrivals = np.cumsum(gaps)
+    arrivals[0] = 0.0  # first request at t=0 starts the clock
+
+    submitted = 0
+    t0 = time.perf_counter()
+    while submitted < len(requests) or engine.has_work:
+        now = time.perf_counter() - t0
+        while (submitted < len(requests)
+               and arrivals[submitted] <= now):
+            p, n = requests[submitted]
+            engine.submit(p, max_new_tokens=n)
+            submitted += 1
+        if engine.has_work:
+            engine.step()
+        elif submitted < len(requests):
+            time.sleep(min(arrivals[submitted] - now, 0.01))
+    wall = time.perf_counter() - t0
+
+    stats = engine.engine_stats()
+    gen = stats["generated_tokens"]
+    tok_s = gen / wall
+    done = engine.completed
+    ttft = sorted((r.first_token_time - r.arrival_time) * 1e3
+                  for r in done)
+    lat = sorted((r.finish_time - r.arrival_time) * 1e3 for r in done)
+
+    # steady-state decode CAPACITY: all slots occupied, no admissions
+    # pending — the timed region is pure jitted-quantum dispatches (the
+    # program the serving_decode_step Budget pins). This isolates the
+    # decode hot loop from the eager chunked-prefill path, whose
+    # ragged-shape op dispatches dominate small-model/CPU runs. The
+    # capacity-vs-batch1 ratio is computed from INTERLEAVED timing
+    # windows (sequential window, quantum window, 3 rounds, median
+    # ratio) so host-load drift hits both sides of each ratio equally.
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.generation import generate_on_device
+
+    q_tokens = engine.config.decode_quantum * quanta
+    for i in range(num_slots):
+        engine.submit(rng.randint(1, cfg.vocab_size, 8)
+                      .astype(np.int32), max_new_tokens=q_tokens + 8)
+    while engine.scheduler.prefilling() or not engine.scheduler.decoding():
+        engine.step()
+    engine._decode_quantum()  # warm
+
+    s_prompt, s_new = (128, 128) if on_tpu else (8, 8)
+    s_ids = paddle.to_tensor(np.random.RandomState(3).randint(
+        1, cfg.vocab_size, (1, s_prompt)))
+
+    def seq_window(calls):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            np.asarray(generate_on_device(
+                model, s_ids, max_new_tokens=s_new)._value)
+        return calls * s_new / (time.perf_counter() - t0)
+
+    def quantum_window(dispatches):
+        g0 = int(engine._n_gen.sum())  # per-slot emitted counters
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            engine._decode_quantum()
+        return ((int(engine._n_gen.sum()) - g0)
+                / (time.perf_counter() - t0))
+
+    seq_window(1)  # both sides warm before the paired rounds
+    pairs = [(seq_window(4 if on_tpu else 8), quantum_window(1))
+             for _ in range(5)]
+    ratios = sorted(q / s for s, q in pairs)
+    q_ratio = ratios[len(ratios) // 2]  # median
+    q_tok_s = max(q for _, q in pairs)
+
+    metric = "serving_engine_ragged_tokens_per_sec"
+    if not on_tpu:
+        metric += "_cpu_smoke"
+    return {
+        "metric": metric, "value": round(tok_s, 1), "unit": "tok/s",
+        "seq_batch1_tokens_per_sec": round(seq_tok_s, 1),
+        "speedup_vs_batch1": round(tok_s / seq_tok_s, 3),
+        "quantum_decode_tokens_per_sec": round(q_tok_s, 1),
+        "quantum_speedup_vs_batch1": round(q_ratio, 3),
+        "num_requests": len(requests), "num_slots": num_slots,
+        "generated_tokens": gen,
+        "mean_occupancy": round(stats.get("mean_occupancy", 0.0), 3),
+        "decode_quanta": stats["decode_quanta"],
+        "mixed_steps": stats["mixed_steps"],
+        "arrival_req_per_s": round(req_rate, 2),
+        "ttft_ms_p50": round(ttft[len(ttft) // 2], 1),
+        "latency_ms_p50": round(lat[len(lat) // 2], 1),
+        "latency_ms_p90": round(lat[int(len(lat) * 0.9)], 1),
+        "pool_peak_blocks": stats["pool"]["peak_blocks_in_use"],
+        "pool_blocks": stats["pool"]["num_blocks"],
+    }
+
+
+def speculative_decode():
+    """VERDICT weak #1: speculative greedy decode tok/s vs the
+    single-dispatch loop, with acceptance rate — both the realistic
+    (independent narrow draft, near-floor acceptance) and the ceiling
+    (draft=target, acceptance=1) arms."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nlp.generation import (
+        generate_on_device, speculative_greedy_search,
+    )
+
+    cfg, on_tpu = _serving_cfg()
+    model = _build_model(cfg, on_tpu)
+    if on_tpu:
+        draft_cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=2, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            tensor_parallel=False)
+        prompt, new, gamma = 128, 128, 4
+    else:
+        draft_cfg = LlamaConfig.tiny(tensor_parallel=False)
+        prompt, new, gamma = 8, 8, 4
+    paddle.seed(1)
+    draft = LlamaForCausalLM(draft_cfg)
+    if on_tpu:
+        draft.astype("bfloat16")
+    draft.eval()
+
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(
+        1, cfg.vocab_size, (1, prompt)))
+
+    def time_it(fn, iters=3):
+        fn()  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        return (time.perf_counter() - t0) / iters, out
+
+    dt_dev, _ = time_it(lambda: np.asarray(generate_on_device(
+        model, ids, max_new_tokens=new)._value))
+    dt_spec, (toks, acc) = time_it(lambda: speculative_greedy_search(
+        model, draft, ids, max_new_tokens=new, gamma=gamma))
+    # ceiling arm: the draft IS the target -> every proposal accepted;
+    # isolates the host-loop + verify-forward overhead from mispredicts
+    dt_self, (_, acc_self) = time_it(lambda: speculative_greedy_search(
+        model, model, ids, max_new_tokens=new, gamma=gamma))
+
+    return {
+        "metric": "speculative_decode_speedup_vs_ondevice",
+        "value": round(dt_dev / dt_spec, 3), "unit": "x",
+        "ondevice_tokens_per_sec": round(new / dt_dev, 1),
+        "spec_tokens_per_sec": round(new / dt_spec, 1),
+        "acceptance_rate": round(float(acc), 3),
+        "selfdraft_speedup": round(dt_dev / dt_self, 2),
+        "selfdraft_acceptance": round(float(acc_self), 3),
+        "gamma": gamma, "new_tokens": new,
+        "draft_params_ratio": "h1024L2 vs h4096L4" if on_tpu
+        else "tiny vs tiny",
+    }
+
+
+CONFIGS = {
+    "serving_engine": serving_engine,
+    "speculative_decode": speculative_decode,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    for name in names:
+        log(f"== {name} ==")
+        t0 = time.perf_counter()
+        try:
+            out = CONFIGS[name]()
+            out["wall_s"] = round(time.perf_counter() - t0, 1)
+            print(json.dumps(out), flush=True)
+        except Exception as e:
+            print(json.dumps(
+                {"metric": name,
+                 "error": f"{type(e).__name__}: {e}"[:200]}),
+                flush=True)
+
+
+if __name__ == "__main__":
+    main()

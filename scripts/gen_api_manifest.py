@@ -43,6 +43,8 @@ def main():
             paddle.incubate.nn.functional),
         "analysis.txt": _callables(
             __import__("paddle_tpu.analysis", fromlist=["analysis"])),
+        "serving.txt": _callables(
+            __import__("paddle_tpu.serving", fromlist=["serving"])),
     }
     for fname, names in sets.items():
         path = os.path.join(OUT, fname)

@@ -22,6 +22,8 @@ NAMESPACES = {
     "incubate_functional.txt": lambda: paddle.incubate.nn.functional,
     "analysis.txt": lambda: __import__(
         "paddle_tpu.analysis", fromlist=["analysis"]),
+    "serving.txt": lambda: __import__(
+        "paddle_tpu.serving", fromlist=["serving"]),
 }
 
 
