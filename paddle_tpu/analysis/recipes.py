@@ -18,6 +18,12 @@ known-good graph shape.
   engine's live post-prefill state). Budget: 0 involuntary remats, 0
   host callbacks/transfers (the no-per-token-host-sync invariant), the
   KV pool leaves all donated, collective-free, and bf16 stays bf16.
+- ``speculative_verify_step``: the speculative serving arm's ONE-
+  dispatch round (draft-γ ``lax.scan`` + single target verify forward
+  + in-graph acceptance/rollback, ``serving/speculative.py``), audited
+  with the engine's live post-prefill state. Budget: same caps as the
+  plain quantum, with BOTH the draft and target KV pool leaves
+  donated.
 
 ``build(name)`` constructs the recipe (installing the mesh it needs)
 and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
@@ -166,10 +172,41 @@ def _build_serving_decode_step():
     return Recipe("serving_decode_step", target, args, budget)
 
 
+def _build_speculative_verify_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    target = LlamaForCausalLM(cfg)
+    draft = LlamaForCausalLM(
+        LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16",
+                         num_hidden_layers=1))
+    engine = ServingEngine(target, spec_draft=draft, spec_gamma=2,
+                           num_slots=2, block_size=4, prefill_chunk=8)
+    rng = np.random.RandomState(0)
+    engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
+                  max_new_tokens=6)
+    engine.step()  # admit + prefill so the audited state is live
+    step, args = engine.decode_step_target()
+    budget = Budget(
+        name="speculative verify round (bf16, single chip)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip serving program
+        max_f32_matmuls=0,        # bf16 pools/params stay bf16
+        max_host_callbacks=0,     # host scheduler only at boundaries
+        require_donated=True,     # draft AND target KV pool leaves
+    )
+    return Recipe("speculative_verify_step", step, args, budget)
+
+
 RECIPES = {
     "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
     "llama_decode_greedy": _build_llama_decode_greedy,
     "serving_decode_step": _build_serving_decode_step,
+    "speculative_verify_step": _build_speculative_verify_step,
 }
 
 

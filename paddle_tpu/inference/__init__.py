@@ -148,7 +148,9 @@ def create_serving_engine(model, **kwargs):
     wrap a causal LM in a :class:`~paddle_tpu.serving.ServingEngine`
     (shared paged KV pool, chunked prefill, single-dispatch decode
     quantum). Keyword args forward to the engine — num_slots,
-    block_size, decode_quantum, decode_strategy, eos_token_id, ...
+    block_size, decode_quantum, decode_strategy, eos_token_id, ...;
+    pass ``spec_draft=<draft LM>`` (and ``spec_gamma``) to switch the
+    quantum to the one-dispatch SPECULATIVE drafter/verifier round.
     See :mod:`paddle_tpu.serving`."""
     from ..serving import ServingEngine
 

@@ -22,7 +22,8 @@ from ..core import autograd
 from ..jit import functional_call
 
 __all__ = ["greedy_search", "generate_on_device", "sampling_search",
-           "beam_search", "generate", "speculative_greedy_search"]
+           "beam_search", "generate", "speculative_greedy_search",
+           "speculative_generate"]
 
 
 def _logits_fn(model, p_vals, ids, offset_val, kc, vc):
@@ -470,15 +471,67 @@ def generate(model, input_ids, max_new_tokens=32,
         f"got {decode_strategy!r}")
 
 
+def speculative_generate(target, draft, input_ids, max_new_tokens=32,
+                         gamma=4, decode_strategy="greedy", top_k=0,
+                         top_p=1.0, temperature=1.0, seed=0,
+                         eos_token_id=None, block_size=32):
+    """ON-DEVICE speculative decoding through the serving engine
+    (reference: the speculative-decoding serving mode of the reference
+    NLP stack — unverified, SURVEY.md §0). Every batch row rides a
+    serving slot; each round — draft scans ``gamma`` proposals, target
+    verifies all γ+1 positions in ONE forward, acceptance prefix and
+    bonus/resample token computed in-graph, both paged KV pools rolled
+    forward/back by length mask — is a single jitted dispatch
+    (serving/speculative.py). The greedy arm emits EXACTLY the
+    target's greedy decode; ``decode_strategy="sampling"`` is
+    distribution-exact rejection sampling (row i seeds with
+    ``seed + i``), deterministic given seeds. This is the serving-grade
+    path that replaces the host-driven ``speculative_greedy_search``
+    (kept below as the reference/bench baseline it beat).
+
+    Returns ``(tokens, acceptance_rate)``: (B, S_in+max_new) ids (rows
+    finishing early at ``eos_token_id`` pad the tail with it) and the
+    draft-proposal acceptance rate across the run."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..serving import ServingEngine
+
+    input_ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle.to_tensor(input_ids)
+    b, s_in = input_ids.shape
+    rows = np.asarray(input_ids._value).astype(np.int32)
+    strategy = ("greedy" if decode_strategy in ("greedy",
+                                                "greedy_search")
+                else decode_strategy)
+    engine = ServingEngine(
+        target, spec_draft=draft, spec_gamma=gamma, num_slots=b,
+        block_size=block_size, max_context=s_in + max_new_tokens,
+        decode_strategy=strategy, top_k=top_k, top_p=top_p,
+        temperature=temperature, eos_token_id=eos_token_id)
+    reqs = [engine.submit(rows[i], max_new_tokens=max_new_tokens,
+                          seed=seed + i) for i in range(b)]
+    engine.run()
+    pad = 0 if eos_token_id is None else int(eos_token_id)
+    out = np.full((b, s_in + max_new_tokens), pad, np.int32)
+    for i, req in enumerate(reqs):
+        toks = engine.output_tokens(req)
+        out[i, :toks.shape[0]] = toks
+    stats = engine.engine_stats()
+    return paddle.to_tensor(out), stats["spec_acceptance_rate"]
+
+
 def speculative_greedy_search(target, draft, input_ids, max_new_tokens=32,
                               gamma=4):
-    """Speculative decoding, greedy variant (reference: the speculative
-    decode serving mode in the reference NLP stack — unverified, SURVEY
-    §0): the DRAFT model proposes ``gamma`` tokens autoregressively, the
-    TARGET verifies them in ONE forward, and the longest prefix matching
-    the target's own greedy choices is accepted plus the target's
-    correction token. Output is EXACTLY the target's greedy decode —
-    the draft only changes how many target forwards it takes.
+    """Speculative decoding, greedy variant, HOST-DRIVEN (reference:
+    the speculative decode serving mode in the reference NLP stack —
+    unverified, SURVEY §0): the DRAFT model proposes ``gamma`` tokens
+    autoregressively, the TARGET verifies them in ONE forward, and the
+    longest prefix matching the target's own greedy choices is accepted
+    plus the target's correction token. Output is EXACTLY the target's
+    greedy decode — the draft only changes how many target forwards it
+    takes. Kept as the debuggable reference and the bench baseline; the
+    serving-grade one-dispatch-per-round path is
+    ``speculative_generate`` / ``ServingEngine(spec_draft=...)``.
 
     Both models share the vocab; batch 1 (acceptance lengths are
     per-sequence). KV caches roll back by position: rejected slots are

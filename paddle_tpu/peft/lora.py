@@ -112,7 +112,19 @@ def _is_linear_like(layer):
     from ..distributed.fleet.layers.mpu.mp_layers import (
         ColumnParallelLinear, RowParallelLinear,
     )
+    from ..nn.quant import QuantizedLinear
 
+    if isinstance(layer, QuantizedLinear):
+        # previously this fell through duck-typing and the quantized
+        # layer was silently skipped — name a target, get an answer
+        raise ValueError(
+            "LoRA target matched a QuantizedLinear base: QLoRA-style "
+            "adapters over int8 bases are not implemented — the low-"
+            "rank delta would train against the dequantized weight "
+            "while merge() cannot fold a float delta into an int8 "
+            "weight without requantization error. Apply LoRA BEFORE "
+            "PTQ convert (then quantize the merged model), or exclude "
+            "quantized layers from target_modules.")
     return isinstance(layer, (Linear, ColumnParallelLinear,
                               RowParallelLinear)) and \
         getattr(layer, "weight", None) is not None

@@ -6,12 +6,19 @@ KV pool (reference: the 2.6-era serving loop around AnalysisPredictor /
 :class:`ServingEngine` multiplexes many in-flight requests over one
 shared :class:`~paddle_tpu.nlp.paged_cache.PagedKVCachePool` and one
 single-dispatch jitted decode step; :mod:`.scheduler` holds the
-admission queue, slot table, and block accounting. The decode step's
-compiled graph is pinned by the ``serving_decode_step`` analysis Budget
-(zero involuntary remat, zero host callbacks, KV pools donated).
-Benched by ``scripts/bench_serving.py`` (ragged Poisson arrivals).
+admission queue, slot table, and block accounting. With a
+``spec_draft`` model the decode quantum becomes the ON-DEVICE
+speculative round of :mod:`.speculative` (draft-γ scan + one-forward
+verify + in-graph acceptance, both paged pools donated). The compiled
+programs are pinned by the ``serving_decode_step`` /
+``speculative_verify_step`` analysis Budgets (zero involuntary remat,
+zero host callbacks, KV pools donated). Benched by
+``scripts/bench_serving.py`` (ragged Poisson arrivals + speculative
+serving vs the plain quantum).
 """
 from .scheduler import Request, Scheduler, SchedulerConfig
 from .engine import ServingEngine
+from .speculative import make_spec_round
 
-__all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine"]
+__all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine",
+           "make_spec_round"]

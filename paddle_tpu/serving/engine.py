@@ -30,6 +30,13 @@ Token selection reuses the generation tier's ``_filter_logits``
 (greedy argmax or temperature/top-k/top-p sampling with per-slot key
 fold-in); the greedy arm is oracle-tested bit-exact against
 per-request sequential ``generate`` (tests/test_serving.py).
+
+With ``spec_draft`` the decode quantum becomes the ON-DEVICE
+speculative round (serving/speculative.py): a second (draft) paged
+pool rides the same scheduler — admission gates on both pools plus the
+verify-write margin, chunked prefill pushes the same mixed batches
+through the draft, and one jitted dispatch per round covers draft-γ
+scan + target verify + in-graph acceptance with BOTH pools donated.
 """
 from __future__ import annotations
 
@@ -51,11 +58,14 @@ __all__ = ["ServingEngine"]
 
 
 def _rope_rows(x, cos, sin):
-    """Rotate (S, H, D) by per-row angles (S, D/2) — the model's
-    default (neox) rotary layout at each slot's own cache position."""
+    """Rotate (..., H, D) by per-row angles (..., D/2) — the model's
+    default (neox) rotary layout at each row's own cache position.
+    Broadcasts over any leading dims: (S, H, D) with (S, D/2) for the
+    decode quantum, (S, C, H, D) with (S, C, D/2) for the speculative
+    verify chunk."""
     xf = x.astype(jnp.float32)
-    c = cos[:, None, :]
-    s = sin[:, None, :]
+    c = cos[..., None, :]
+    s = sin[..., None, :]
     d = x.shape[-1]
     x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
@@ -84,6 +94,32 @@ def _xla_paged_decode_attn(q, kp, vp, tables, lens):
     return out.astype(q.dtype)
 
 
+def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens):
+    """Chunked decode attention over the paged pool (the speculative
+    VERIFY pass): query position j of each slot attends pool positions
+    < base+j+1 — the same gather + f32 masked softmax as
+    `_xla_paged_decode_attn` with an extra in-chunk causal dimension.
+    q is (S, C, H, D); no Pallas analog yet, the gather fallback runs
+    on every backend."""
+    s_, c, h, d = q.shape
+    w = tables.shape[1]
+    bs, hk = kp.shape[1], kp.shape[2]
+    k = kp[tables].reshape(s_, w * bs, hk, d)
+    v = vp[tables].reshape(s_, w * bs, hk, d)
+    rep = h // hk
+    kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+    vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+    sc = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bchd,bkhd->bhck", q.astype(jnp.float32),
+                        kr.astype(jnp.float32)) * sc
+    lens = base_lens[:, None] + jnp.arange(c)[None, :] + 1   # (S, C)
+    mask = jnp.arange(w * bs)[None, None, :] < lens[:, :, None]
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhck,bkhd->bchd", p, vr.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def _paged_attn(q, kp, vp, tables, lens):
     """Route decode attention: Pallas paged kernel on TPU (block tables
     dereferenced in SMEM, one pool block DMA per grid step), XLA gather
@@ -100,15 +136,132 @@ def _paged_attn(q, kp, vp, tables, lens):
     return _xla_paged_decode_attn(q, kp, vp, tables, lens)
 
 
+def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
+                      kc, vc, live):
+    """One token for every slot over a paged pool (the quantum's
+    per-step body; mirrors generation._manual_decode with block-table
+    writes instead of dense-cache slice updates). Parameterized by
+    ``model`` so the plain quantum (target) and the speculative DRAFT
+    scan (serving/speculative.py) share one decode-step definition."""
+    cfg = model.config
+    core = model.llama
+    s = ids_t.shape[0]
+    h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                cfg.head_dim)
+    bs = kc[0].shape[1]
+    w = tables.shape[1]
+
+    hidden = core.embed_tokens(ids_t)                # (S, 1, E)
+    inv_freq = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = seq_lens.astype(jnp.float32)
+    freqs = pos[:, None] * inv_freq[None, :]
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)        # (S, D/2)
+
+    blk_idx = jnp.clip(seq_lens // bs, 0, w - 1)
+    own_blk = jnp.take_along_axis(tables, blk_idx[:, None],
+                                  axis=1)[:, 0]
+    write_blk = jnp.where(live, own_blk, scratch_block)
+    write_off = jnp.where(live, seq_lens % bs, 0)
+    lens = jnp.where(live, seq_lens + 1, 1)
+
+    new_kc, new_vc = [], []
+    for i, layer in enumerate(core.layers):
+        attn = layer.self_attn
+        residual = hidden
+        x = layer.input_layernorm(hidden)
+        q = attn.q_proj(x).reshape([s, 1, h, d])
+        k = attn.k_proj(x).reshape([s, 1, hk, d])
+        v = attn.v_proj(x).reshape([s, 1, hk, d])
+        qv = _rope_rows(q._value[:, 0], cos, sin)    # (S, H, D)
+        kv = _rope_rows(k._value[:, 0], cos, sin)
+        kci = kc[i].at[write_blk, write_off].set(
+            kv.astype(kc[i].dtype))
+        vci = vc[i].at[write_blk, write_off].set(
+            v._value[:, 0].astype(vc[i].dtype))
+        new_kc.append(kci)
+        new_vc.append(vci)
+        att = _paged_attn(qv, kci, vci, tables, lens)
+        att_t = Tensor(att.reshape(s, 1, h * d), stop_gradient=True)
+        hidden = residual + attn.o_proj(att_t)
+        hidden = hidden + layer.mlp(
+            layer.post_attention_layernorm(hidden))
+    hidden = core.norm(hidden)
+    logits = model.lm_head(hidden)
+    return logits._value[:, 0], new_kc, new_vc
+
+
+def paged_chunk_math(model, scratch_block, ids_t, seq_lens, tables,
+                     kc, vc, live):
+    """C-token suffix forward for every slot over a paged pool — the
+    speculative round's TARGET verify pass (reference: the speculative
+    verify forward of the reference's serving stack — unverified,
+    SURVEY.md §0). Chunk position j writes its KV at ``seq_lens + j``
+    (masked rows go to the scratch block) and attends its own prefix;
+    one batched forward covers all slots and all γ+1 positions. Stale
+    tail slots from rejected proposals are rolled back by LENGTH MASK:
+    the caller shrinks ``seq_lens`` and the next round's writes simply
+    overwrite them."""
+    cfg = model.config
+    core = model.llama
+    s, c = ids_t.shape
+    h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                cfg.head_dim)
+    bs = kc[0].shape[1]
+    w = tables.shape[1]
+
+    hidden = core.embed_tokens(ids_t)                # (S, C, E)
+    inv_freq = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos_f = (seq_lens[:, None]
+             + jnp.arange(c)[None, :]).astype(jnp.float32)
+    freqs = pos_f[..., None] * inv_freq              # (S, C, D/2)
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+
+    wpos = seq_lens[:, None] + jnp.arange(c)[None, :]
+    blk_idx = jnp.clip(wpos // bs, 0, w - 1)
+    own_blk = jnp.take_along_axis(tables, blk_idx, axis=1)
+    write_blk = jnp.where(live[:, None], own_blk, scratch_block)
+    write_off = jnp.where(live[:, None], wpos % bs, 0)
+    base_lens = jnp.where(live, seq_lens, 0)
+
+    new_kc, new_vc = [], []
+    for i, layer in enumerate(core.layers):
+        attn = layer.self_attn
+        residual = hidden
+        x = layer.input_layernorm(hidden)
+        q = attn.q_proj(x).reshape([s, c, h, d])
+        k = attn.k_proj(x).reshape([s, c, hk, d])
+        v = attn.v_proj(x).reshape([s, c, hk, d])
+        qv = _rope_rows(q._value, cos, sin)          # (S, C, H, D)
+        kv = _rope_rows(k._value, cos, sin)
+        kci = kc[i].at[write_blk, write_off].set(
+            kv.astype(kc[i].dtype))
+        vci = vc[i].at[write_blk, write_off].set(
+            v._value.astype(vc[i].dtype))
+        new_kc.append(kci)
+        new_vc.append(vci)
+        att = _xla_paged_chunk_attn(qv, kci, vci, tables, base_lens)
+        att_t = Tensor(att.reshape(s, c, h * d), stop_gradient=True)
+        hidden = residual + attn.o_proj(att_t)
+        hidden = hidden + layer.mlp(
+            layer.post_attention_layernorm(hidden))
+    hidden = core.norm(hidden)
+    logits = model.lm_head(hidden)
+    return logits._value, new_kc, new_vc
+
+
 class _AuditedStep:
     """Callable+lowerable wrapper handed to ``analysis.check_budget``:
-    declares how many LEADING flat args the quantum donates (the 2L KV
-    pool leaves) so ``require_donated`` audits the right set."""
+    declares how many LEADING flat args the quantum donates (the KV
+    pool leaves — 2L for the plain quantum, 2L_target + 2L_draft for
+    the speculative round) so ``require_donated`` audits the right
+    set."""
 
-    def __init__(self, jitted, n_donatable):
+    def __init__(self, jitted, n_donatable, name="serving_decode_quantum"):
         self._jitted = jitted
         self.n_donatable = int(n_donatable)
-        self.__name__ = "serving_decode_quantum"
+        self.__name__ = name
 
     def __call__(self, *args):
         return self._jitted(*args)
@@ -134,12 +287,22 @@ class ServingEngine:
         decode_strategy: "greedy" | "sampling" (engine-wide; sampling
             knobs via top_k/top_p/temperature, per-request seeds).
         eos_token_id: retire a slot the step after it emits this id.
+        spec_draft: optional DRAFT causal LM (same vocab) switching the
+            decode quantum to the speculative drafter/verifier round
+            (serving/speculative.py): the draft scans ``spec_gamma``
+            proposals, the target verifies all γ+1 positions in one
+            forward, and acceptance/bonus/resample + both caches' roll
+            forward/back happen in-graph — ONE dispatch per round. The
+            greedy arm emits exactly the target's greedy stream; the
+            sampling arm is distribution-exact rejection sampling.
+        spec_gamma: proposals per speculative round (default 4).
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
                  max_context=None, prefill_chunk=64, decode_quantum=8,
                  decode_strategy="greedy", top_k=0, top_p=1.0,
-                 temperature=1.0, eos_token_id=None):
+                 temperature=1.0, eos_token_id=None, spec_draft=None,
+                 spec_gamma=4):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -150,8 +313,25 @@ class ServingEngine:
             raise ValueError(
                 f"decode_strategy must be greedy|sampling, got "
                 f"{decode_strategy!r}")
+        if spec_draft is not None:
+            d_cfg = spec_draft.config
+            if getattr(d_cfg, "sliding_window", None):
+                raise NotImplementedError(
+                    "speculative serving with a sliding-window draft is "
+                    "not supported: rollback-by-length-mask cannot "
+                    "restore rolling-buffer slots rejected proposals "
+                    "wrapped over")
+            if d_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {d_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}: acceptance compares token ids")
+            if int(spec_gamma) < 1:
+                raise ValueError(
+                    f"spec_gamma must be >= 1, got {spec_gamma}")
         self.model = model
         model.eval()
+        self.spec_draft = spec_draft
+        self.spec_gamma = int(spec_gamma)
         self.config = SchedulerConfig(num_slots=num_slots,
                                       prefill_chunk=prefill_chunk,
                                       decode_quantum=decode_quantum)
@@ -168,7 +348,11 @@ class ServingEngine:
         cache_dtype = self._p_vals[0].dtype
         s = self.config.num_slots
         bs = int(block_size)
-        w = -(-self.max_context // bs)
+        # the speculative verify writes up to gamma slots past the
+        # accepted history before the length mask rolls them back, so
+        # tables (and the worst-case admission demand) carry that margin
+        margin = self.spec_gamma if spec_draft is not None else 0
+        w = -(-(self.max_context + margin) // bs)
         if num_blocks is None:
             num_blocks = s * w + 1  # +1: the masked-write scratch block
         self.pool = PagedKVCachePool(
@@ -176,8 +360,22 @@ class ServingEngine:
             num_layers=cfg.num_hidden_layers, dtype=cache_dtype)
         # masked (retired/empty) rows dump their KV writes here
         self._scratch_block = self.pool.ensure("__scratch__", 1)[0]
-        self.scheduler = Scheduler(self.config, self.pool,
-                                   reserved_blocks=1)
+        self.d_pool = None
+        if spec_draft is not None:
+            spec_draft.eval()
+            self._d_p_vals = [p._value
+                              for _, p in spec_draft.named_parameters()]
+            d_cfg = spec_draft.config
+            self.d_pool = PagedKVCachePool(
+                num_blocks, bs, d_cfg.num_key_value_heads,
+                d_cfg.head_dim, num_layers=d_cfg.num_hidden_layers,
+                dtype=self._d_p_vals[0].dtype)
+            self._d_scratch_block = self.d_pool.ensure("__scratch__",
+                                                       1)[0]
+        self.scheduler = Scheduler(
+            self.config, self.pool, reserved_blocks=1,
+            companion_pools=[self.d_pool] if self.d_pool is not None
+            else [], token_margin=margin)
         self._table_width = w
 
         # host mirrors of the per-slot device state
@@ -197,14 +395,33 @@ class ServingEngine:
                                     base=cfg.rope_theta)
         self._rotary = Tensor(jnp.stack([cos, sin]), stop_gradient=True)
 
-        self._quantum = jax.jit(self._make_quantum(),
-                                donate_argnums=(0, 1))
-        self._audited = _AuditedStep(
-            self._quantum, n_donatable=2 * cfg.num_hidden_layers)
+        if spec_draft is not None:
+            from .speculative import make_spec_round
+
+            self._d_tables = np.zeros((s, w), np.int32)
+            d_cos, d_sin = build_rope_cache(
+                self.max_context, d_cfg.head_dim,
+                base=d_cfg.rope_theta)
+            self._d_rotary = Tensor(jnp.stack([d_cos, d_sin]),
+                                    stop_gradient=True)
+            self._quantum = jax.jit(make_spec_round(self),
+                                    donate_argnums=(0, 1, 2, 3))
+            self._audited = _AuditedStep(
+                self._quantum,
+                n_donatable=2 * (cfg.num_hidden_layers
+                                 + d_cfg.num_hidden_layers),
+                name="speculative_verify_step")
+        else:
+            self._quantum = jax.jit(self._make_quantum(),
+                                    donate_argnums=(0, 1))
+            self._audited = _AuditedStep(
+                self._quantum, n_donatable=2 * cfg.num_hidden_layers)
         self.completed: list = []
         self.stats = {"steps": 0, "mixed_steps": 0, "decode_quanta": 0,
                       "quantum_tokens": 0, "prefill_tokens": 0,
-                      "generated_tokens": 0, "occupancy_sum": 0.0}
+                      "generated_tokens": 0, "occupancy_sum": 0.0,
+                      "spec_rounds": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, req_id=None, seed=0,
@@ -269,6 +486,11 @@ class ServingEngine:
         if self.stats["steps"]:
             out["mean_occupancy"] = (self.stats["occupancy_sum"]
                                      / self.stats["steps"])
+        if self.d_pool is not None:
+            out["draft_pool"] = self.d_pool.fragmentation_stats()
+            out["spec_acceptance_rate"] = (
+                self.stats["spec_accepted"]
+                / max(self.stats["spec_proposed"], 1))
         return out
 
     def decode_step_target(self):
@@ -289,47 +511,23 @@ class ServingEngine:
             self._max_new[slot] = req.max_new_tokens
             self._keys[slot] = np.asarray(jax.random.PRNGKey(req.seed))
 
-    def _mixed_step(self):
-        """One chunk of prefill for every prefilling slot, one decode
-        token for every in-flight slot — a single MIXED batch through
-        ``block_multihead_attention`` per layer (chunked prefill
-        interleaved with decode, the reference's serving batch shape)."""
+    def _mixed_forward(self, model, pool, tables, rotary, enc_lens,
+                       dec_lens, this_time, ids, total):
+        """One mixed prefill(+decode) forward of ``model`` over
+        ``pool`` through ``block_multihead_attention`` — shared by the
+        target and (in the speculative arm) the DRAFT, which must
+        ingest exactly the same rows so its cache stays in lockstep
+        with the target's. Returns the (1, T, E) hidden states; the
+        mutated pool Tensors are written back as the new truth."""
         import paddle_tpu as paddle
         from ..incubate.nn.functional import block_multihead_attention
 
-        self.stats["mixed_steps"] += 1
-        model, cfg = self.model, self.model.config
-        chunk = self.config.prefill_chunk
-        pre = self.scheduler.prefilling()
-        dec = self.scheduler.decoding()
-        rows = pre + dec
-        toks, this_time, enc_lens, dec_lens = [], [], [], []
-        for req in pre:
-            n = min(chunk, req.prompt_len - req.prefill_pos)
-            toks.append(req.prompt[req.prefill_pos:req.prefill_pos + n])
-            this_time.append(n)
-            enc_lens.append(n)
-            dec_lens.append(req.prefill_pos)
-            self.pool.ensure(req.req_id, req.prefill_pos + n)
-        for req in dec:
-            slot = req.slot
-            toks.append(np.asarray([self._last_tok[slot]], np.int32))
-            this_time.append(1)
-            enc_lens.append(0)
-            dec_lens.append(int(self._seq_lens[slot]))
-            self.pool.ensure(req.req_id, int(self._seq_lens[slot]) + 1)
-        ids = np.concatenate(toks).astype(np.int32)
-        total = int(ids.shape[0])
-        self.stats["prefill_tokens"] += int(sum(enc_lens))
-        cu = np.concatenate([[0], np.cumsum(this_time)]).astype(np.int32)
-        tables = self.pool.block_table_array(
-            [r.req_id for r in rows], pad_to=self._table_width)
-
+        cfg = model.config
         h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                     cfg.head_dim)
-        kc_t = [Tensor(self.pool.k_pools[i], stop_gradient=True)
+        kc_t = [Tensor(pool.k_pools[i], stop_gradient=True)
                 for i in range(cfg.num_hidden_layers)]
-        vc_t = [Tensor(self.pool.v_pools[i], stop_gradient=True)
+        vc_t = [Tensor(pool.v_pools[i], stop_gradient=True)
                 for i in range(cfg.num_hidden_layers)]
         common = dict(
             seq_lens_encoder=paddle.to_tensor(
@@ -339,7 +537,7 @@ class ServingEngine:
             seq_lens_this_time=paddle.to_tensor(
                 np.asarray(this_time, np.int32)),
             block_tables=Tensor(tables, stop_gradient=True),
-            rotary_embs=self._rotary,
+            rotary_embs=rotary,
             use_neox_rotary_style=True,  # the model's rope layout
             num_heads=h, kv_num_heads=hk, head_dim=d,
         )
@@ -365,8 +563,61 @@ class ServingEngine:
             hidden = core.norm(hidden)
         # the mutated pool Tensors are the new truth
         for i in range(cfg.num_hidden_layers):
-            self.pool.k_pools[i] = kc_t[i]._value
-            self.pool.v_pools[i] = vc_t[i]._value
+            pool.k_pools[i] = kc_t[i]._value
+            pool.v_pools[i] = vc_t[i]._value
+        return hidden
+
+    def _mixed_step(self):
+        """One chunk of prefill for every prefilling slot, one decode
+        token for every in-flight slot — a single MIXED batch through
+        ``block_multihead_attention`` per layer (chunked prefill
+        interleaved with decode, the reference's serving batch shape).
+        The speculative arm pushes the SAME batch through the draft
+        model into the draft pool (token selection stays the target's;
+        the draft forward exists only for its KV writes)."""
+        model = self.model
+        self.stats["mixed_steps"] += 1
+        chunk = self.config.prefill_chunk
+        pre = self.scheduler.prefilling()
+        dec = self.scheduler.decoding()
+        rows = pre + dec
+        spec = self.spec_draft is not None
+        toks, this_time, enc_lens, dec_lens = [], [], [], []
+        for req in pre:
+            n = min(chunk, req.prompt_len - req.prefill_pos)
+            toks.append(req.prompt[req.prefill_pos:req.prefill_pos + n])
+            this_time.append(n)
+            enc_lens.append(n)
+            dec_lens.append(req.prefill_pos)
+            self.pool.ensure(req.req_id, req.prefill_pos + n)
+            if spec:
+                self.d_pool.ensure(req.req_id, req.prefill_pos + n)
+        for req in dec:
+            slot = req.slot
+            toks.append(np.asarray([self._last_tok[slot]], np.int32))
+            this_time.append(1)
+            enc_lens.append(0)
+            dec_lens.append(int(self._seq_lens[slot]))
+            self.pool.ensure(req.req_id, int(self._seq_lens[slot]) + 1)
+            if spec:
+                self.d_pool.ensure(req.req_id,
+                                   int(self._seq_lens[slot]) + 1)
+        ids = np.concatenate(toks).astype(np.int32)
+        total = int(ids.shape[0])
+        self.stats["prefill_tokens"] += int(sum(enc_lens))
+        cu = np.concatenate([[0], np.cumsum(this_time)]).astype(np.int32)
+        row_ids = [r.req_id for r in rows]
+        tables = self.pool.block_table_array(
+            row_ids, pad_to=self._table_width)
+        hidden = self._mixed_forward(
+            model, self.pool, tables, self._rotary, enc_lens, dec_lens,
+            this_time, ids, total)
+        if spec:
+            d_tables = self.d_pool.block_table_array(
+                row_ids, pad_to=self._table_width)
+            self._mixed_forward(
+                self.spec_draft, self.d_pool, d_tables, self._d_rotary,
+                enc_lens, dec_lens, this_time, ids, total)
 
         # logits only where a next token is due: rows completing their
         # prefill this chunk, and every decode row
@@ -430,59 +681,9 @@ class ServingEngine:
         return jax.vmap(jax.random.categorical)(
             step_keys, filt).astype(jnp.int32)
 
-    def _paged_decode_math(self, ids_t, seq_lens, tables, kc, vc, live):
-        """One token for every slot over the paged pool (the quantum's
-        per-step body; mirrors generation._manual_decode with block-table
-        writes instead of dense-cache slice updates)."""
-        model, cfg = self.model, self.model.config
-        core = model.llama
-        s = ids_t.shape[0]
-        h, hk, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                    cfg.head_dim)
-        bs = self.pool.block_size
-        w = tables.shape[1]
-
-        hidden = core.embed_tokens(ids_t)                # (S, 1, E)
-        inv_freq = 1.0 / (cfg.rope_theta ** (
-            jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-        pos = seq_lens.astype(jnp.float32)
-        freqs = pos[:, None] * inv_freq[None, :]
-        cos, sin = jnp.cos(freqs), jnp.sin(freqs)        # (S, D/2)
-
-        blk_idx = jnp.clip(seq_lens // bs, 0, w - 1)
-        own_blk = jnp.take_along_axis(tables, blk_idx[:, None],
-                                      axis=1)[:, 0]
-        write_blk = jnp.where(live, own_blk, self._scratch_block)
-        write_off = jnp.where(live, seq_lens % bs, 0)
-        lens = jnp.where(live, seq_lens + 1, 1)
-
-        new_kc, new_vc = [], []
-        for i, layer in enumerate(core.layers):
-            attn = layer.self_attn
-            residual = hidden
-            x = layer.input_layernorm(hidden)
-            q = attn.q_proj(x).reshape([s, 1, h, d])
-            k = attn.k_proj(x).reshape([s, 1, hk, d])
-            v = attn.v_proj(x).reshape([s, 1, hk, d])
-            qv = _rope_rows(q._value[:, 0], cos, sin)    # (S, H, D)
-            kv = _rope_rows(k._value[:, 0], cos, sin)
-            kci = kc[i].at[write_blk, write_off].set(
-                kv.astype(kc[i].dtype))
-            vci = vc[i].at[write_blk, write_off].set(
-                v._value[:, 0].astype(vc[i].dtype))
-            new_kc.append(kci)
-            new_vc.append(vci)
-            att = _paged_attn(qv, kci, vci, tables, lens)
-            att_t = Tensor(att.reshape(s, 1, h * d), stop_gradient=True)
-            hidden = residual + attn.o_proj(att_t)
-            hidden = hidden + layer.mlp(
-                layer.post_attention_layernorm(hidden))
-        hidden = core.norm(hidden)
-        logits = model.lm_head(hidden)
-        return logits._value[:, 0], new_kc, new_vc
-
     def _make_quantum(self):
         model = self.model
+        scratch = self._scratch_block
         t_steps = self.config.decode_quantum
         has_eos = self.eos_token_id is not None
         eos = -1 if self.eos_token_id is None else int(self.eos_token_id)
@@ -494,8 +695,9 @@ class ServingEngine:
                 live = ~done
                 with autograd.no_grad():
                     def fwd(tok_t):
-                        return self._paged_decode_math(
-                            tok_t, seq_lens, tables, kc, vc, live)
+                        return paged_decode_math(
+                            model, scratch, tok_t, seq_lens, tables,
+                            kc, vc, live)
 
                     (logits, kc2, vc2), _ = functional_call(
                         model, fwd,
@@ -519,6 +721,18 @@ class ServingEngine:
         return quantum
 
     def _quantum_args(self):
+        if self.spec_draft is not None:
+            return (list(self.pool.k_pools), list(self.pool.v_pools),
+                    list(self.d_pool.k_pools),
+                    list(self.d_pool.v_pools),
+                    self._p_vals, self._d_p_vals,
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._d_tables),
+                    jnp.asarray(self._seq_lens),
+                    jnp.asarray(self._last_tok),
+                    jnp.asarray(self._n_gen), jnp.asarray(self._done),
+                    jnp.asarray(self._max_new),
+                    jnp.asarray(self._keys))
         return (list(self.pool.k_pools), list(self.pool.v_pools),
                 self._p_vals, jnp.asarray(self._tables),
                 jnp.asarray(self._seq_lens),
@@ -526,10 +740,61 @@ class ServingEngine:
                 jnp.asarray(self._done), jnp.asarray(self._max_new),
                 jnp.asarray(self._keys))
 
+    def _spec_round_step(self):
+        """Dispatch ONE jitted speculative round (draft-γ scan + target
+        verify + in-graph acceptance and cache roll forward/back); the
+        host runs only here, at the admit/retire boundary — variable
+        per-round token yield composes with the same retirement masks
+        as the plain quantum."""
+        g = self.spec_gamma
+        self.stats["spec_rounds"] += 1
+        rows = self.scheduler.decoding()
+        for req in rows:
+            slot = req.slot
+            # cover the round's worst-case writes (γ proposals past the
+            # accepted history) in BOTH pools before entering the
+            # device loop — tables are static inside
+            need = int(self._seq_lens[slot]) + g + 1
+            for pool, tables in ((self.pool, self._tables),
+                                 (self.d_pool, self._d_tables)):
+                if need > pool.seq_len(req.req_id):
+                    pool.ensure(req.req_id, need)
+                row = pool.block_table_array(
+                    [req.req_id], pad_to=self._table_width)
+                tables[slot] = np.asarray(row)[0][:self._table_width]
+        (t_kc, t_vc, d_kc, d_vc, seq_lens, last_tok, n_gen, done,
+         stream, counts, acc) = self._quantum(*self._quantum_args())
+        self.pool.k_pools = list(t_kc)
+        self.pool.v_pools = list(t_vc)
+        self.d_pool.k_pools = list(d_kc)
+        self.d_pool.v_pools = list(d_vc)
+        stream = np.asarray(stream)                      # (S, γ+1) sync
+        counts = np.asarray(counts)
+        acc = np.asarray(acc)
+        self._seq_lens = np.asarray(seq_lens).copy()
+        self._last_tok = np.asarray(last_tok).copy()
+        self._n_gen = np.asarray(n_gen).copy()
+        self._done = np.asarray(done).copy()
+        self.stats["quantum_tokens"] += int(counts.sum())
+        self.stats["spec_proposed"] += g * len(rows)
+        self.stats["spec_accepted"] += int(acc.sum())
+        now = time.perf_counter()
+        for req in rows:
+            slot = req.slot
+            for k in range(int(counts[slot])):
+                if req.finished:
+                    break
+                req.record(int(stream[slot, k]), self.eos_token_id)
+            if req.finished:
+                req.finish_time = now
+        self._retire_finished()
+
     def _decode_quantum(self):
         """Dispatch one jitted quantum; the single host sync per
         ``decode_quantum`` tokens happens HERE, at the admit/retire
         boundary, never inside the compiled loop."""
+        if self.spec_draft is not None:
+            return self._spec_round_step()
         self.stats["decode_quanta"] += 1
         t_steps = self.config.decode_quantum
         # grow each live slot's block table to cover the quantum before

@@ -103,11 +103,29 @@ class SchedulerConfig:
 
 
 class Scheduler:
-    """Admission queue + slot table + block reservations."""
+    """Admission queue + slot table + block reservations.
 
-    def __init__(self, config, pool, reserved_blocks=0):
+    ``companion_pools`` are additional pools every admitted request also
+    occupies (the speculative engine's DRAFT KV pool); they must share
+    the main pool's block size, and capacity is gated on the TIGHTEST
+    pool. ``token_margin`` widens the worst-case demand by a per-request
+    token slack — the speculative verify step writes up to ``gamma``
+    proposal slots past the accepted history, so admission must reserve
+    the blocks those writes can touch."""
+
+    def __init__(self, config, pool, reserved_blocks=0,
+                 companion_pools=(), token_margin=0):
         self.config = config
         self.pool = pool
+        self.companion_pools = [p for p in companion_pools
+                                if p is not None]
+        for p in self.companion_pools:
+            if p.block_size != pool.block_size:
+                raise ValueError(
+                    f"companion pool block_size {p.block_size} != main "
+                    f"pool {pool.block_size}: one demand number must "
+                    f"cover every pool")
+        self.token_margin = int(token_margin)
         self.waiting = deque()
         self.slots = [None] * config.num_slots
         # blocks permanently unavailable to requests (engine scratch)
@@ -124,11 +142,19 @@ class Scheduler:
         return request
 
     def _demand(self, req):
-        return self.pool.blocks_needed(req.prompt_len + req.max_new_tokens)
+        return self.pool.blocks_needed(
+            req.prompt_len + req.max_new_tokens + self.token_margin)
 
     @property
     def reserved_blocks(self):
         return self._base_reserved + sum(self._reservations.values())
+
+    @property
+    def _capacity(self):
+        """Blocks the TIGHTEST pool offers — with a companion (draft)
+        pool, a request only admits when it fits in every pool."""
+        return min([self.pool.num_blocks]
+                   + [p.num_blocks for p in self.companion_pools])
 
     def try_admit(self):
         """Move waiting requests into free slots while their worst-case
@@ -141,13 +167,13 @@ class Scheduler:
                 break
             req = self.waiting[0]
             need = self._demand(req)
-            if need > self.pool.num_blocks - self._base_reserved:
+            if need > self._capacity - self._base_reserved:
                 self.waiting.popleft()
                 raise ValueError(
                     f"request {req.req_id}: needs {need} blocks, pool "
-                    f"only has {self.pool.num_blocks - self._base_reserved} "
+                    f"only has {self._capacity - self._base_reserved} "
                     f"usable — raise num_blocks or split the request")
-            if self.reserved_blocks + need > self.pool.num_blocks:
+            if self.reserved_blocks + need > self._capacity:
                 break
             self.waiting.popleft()
             req.slot = free[0]
@@ -159,8 +185,10 @@ class Scheduler:
 
     def retire(self, req):
         """Release a finished request's slot, reservation, and pool
-        blocks (free-list reuse is immediate)."""
+        blocks in EVERY pool (free-list reuse is immediate)."""
         self.pool.free(req.req_id)
+        for p in self.companion_pools:
+            p.free(req.req_id)
         self._reservations.pop(req, None)
         if req.slot is not None:
             self.slots[req.slot] = None

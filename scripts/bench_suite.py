@@ -10,7 +10,7 @@ Configs: resnet50_eager | resnet50_jit | gpt2_jit | ernie_engine |
 sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
-serving_engine | speculative_decode
+serving_engine | speculative_decode | speculative_serving
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -924,10 +924,18 @@ def speculative_decode():
     return _bench_serving().speculative_decode()
 
 
+def speculative_serving():
+    """On-device speculative serving round vs the plain decode quantum
+    (ISSUE 3 tentpole; methodology + stand-in pair construction in
+    scripts/bench_serving.py, artifact BENCH_SPEC_r07.json)."""
+    return _bench_serving().speculative_serving()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "serving_engine": serving_engine,
     "speculative_decode": speculative_decode,
+    "speculative_serving": speculative_serving,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

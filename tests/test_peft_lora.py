@@ -159,6 +159,53 @@ def test_lora_trainable_bias_scoped_to_wrapped_layers():
     assert all("lora_" in k or ".base." in k for k in sd)
 
 
+def test_lora_rejects_quantized_linear_base():
+    """VERDICT weak #8: a PTQ-converted (QuantizedLinear) base that
+    matches target_modules must raise the QLoRA-gap error instead of
+    silently falling through duck-typing (which skipped the layer and
+    wrapped nothing)."""
+    from paddle_tpu.nn.quant import QuantizedLinear
+
+    m = _llama()
+    attn = m.llama.layers[0].self_attn
+    attn.q_proj = QuantizedLinear.from_linear(attn.q_proj)
+    with pytest.raises(ValueError, match="QuantizedLinear.*QLoRA"):
+        get_lora_model(m, LoRAConfig(r=2))
+
+
+def test_lora_state_dict_checkpoint_roundtrip(tmp_path):
+    """VERDICT item 7 (checkpointing half): the adapter artifact
+    survives distributed.checkpoint save/load — loading it onto a
+    FRESH base + fresh LoRA wrap restores the trained forward
+    exactly."""
+    from paddle_tpu.distributed.checkpoint import (
+        save_state_dict, load_state_dict,
+    )
+
+    m = _llama()
+    lora = get_lora_model(m, LoRAConfig(r=4, lora_alpha=8))
+    # perturb the adapters so the roundtrip carries real signal (in
+    # particular B != 0, else the delta is zero whatever A holds)
+    rng = np.random.RandomState(3)
+    for n, p in lora.named_parameters():
+        if "lora_" in n:
+            p.set_value(p.numpy()
+                        + rng.randn(*p.shape).astype("float32") * 0.05)
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(0, 128,
+                                                            (2, 10)))
+    want = lora(ids).numpy()
+    sd = lora_state_dict(lora)
+    save_state_dict(sd, str(tmp_path / "adapter"))
+
+    fresh = get_lora_model(_llama(), LoRAConfig(r=4, lora_alpha=8))
+    assert np.abs(fresh(ids).numpy() - want).max() > 1e-5  # differs pre-load
+    dest = {k: v for k, v in fresh.state_dict().items() if k in sd}
+    assert sorted(dest) == sorted(sd)
+    load_state_dict(dest, str(tmp_path / "adapter"))
+    np.testing.assert_allclose(fresh(ids).numpy(), want,
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_lora_a_init_variance_is_one_over_r():
     """ADVICE round-5 low: A ~ N(0, 1/r) means std = sqrt(1/r), not
     1/r — with std=1/r the adapter update scale shrank quadratically in

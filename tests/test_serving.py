@@ -6,7 +6,16 @@ ragged arrivals with slot reuse, plus pool-allocator lifecycle
 (free-list reuse after retirement, exhaustion refusal, fragmentation
 counters), scheduler admission gating, and the registered
 ``serving_decode_step`` analysis budget (zero involuntary remat, zero
-host syncs in the jitted quantum, KV pool leaves donated)."""
+host syncs in the jitted quantum, KV pool leaves donated).
+
+The SPECULATIVE serving arm (ISSUE 3) gets the same treatment: the
+greedy drafter/verifier round is bit-exact vs sequential generate with
+an arbitrary independent draft (exactness by construction), the
+rejection-sampling arm replays the plain sampling engine bit-for-bit
+when draft == target on fixed seeds, eos/max-new retirement composes
+with variable per-round yield, admission accounts for the draft pool,
+and the ``speculative_verify_step`` budget pins the one-dispatch
+round."""
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -14,7 +23,9 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.nlp import PagedKVCachePool
-from paddle_tpu.nlp.generation import generate_on_device
+from paddle_tpu.nlp.generation import (
+    generate_on_device, speculative_generate,
+)
 from paddle_tpu.serving import Request, Scheduler, SchedulerConfig
 from paddle_tpu.serving import ServingEngine
 
@@ -26,6 +37,18 @@ def tiny_model():
     model = LlamaForCausalLM(cfg)
     model.eval()
     return cfg, model
+
+
+@pytest.fixture(scope="module")
+def tiny_draft():
+    """An INDEPENDENT (random-init, shallower) draft: near-floor
+    acceptance, which is exactly the adversarial case for greedy
+    exactness-by-construction."""
+    paddle.seed(11)
+    draft = LlamaForCausalLM(
+        LlamaConfig.tiny(tensor_parallel=False, num_hidden_layers=1))
+    draft.eval()
+    return draft
 
 
 def _oracle_row(model, prompt, max_new, eos_token_id=None):
@@ -97,24 +120,19 @@ def test_engine_eos_retirement(tiny_model):
     assert engine.pool.fragmentation_stats()["blocks_in_use"] == 1
 
 
-def test_engine_sampling_smoke(tiny_model):
+def test_engine_sampling_smoke(tiny_model, sampling_prompts,
+                               plain_sampling_outputs):
     """The sampling arm drives to completion with per-request seeds and
     in-vocab tokens (selection math shared with generation's
-    _filter_logits; distributional parity is its own test tier)."""
-    cfg, model = tiny_model
-    rng = np.random.RandomState(2)
-    engine = ServingEngine(model, num_slots=2, block_size=4,
-                           prefill_chunk=4, decode_quantum=3,
-                           decode_strategy="sampling", top_k=8,
-                           temperature=0.9)
-    reqs = [engine.submit(rng.randint(1, cfg.vocab_size, n)
-                          .astype(np.int32), max_new_tokens=5, seed=i)
-            for i, n in enumerate((5, 7, 3))]
-    done = engine.run()
-    assert len(done) == 3
-    for req in reqs:
-        assert len(req.tokens) == 5
-        assert all(0 <= t < cfg.vocab_size for t in req.tokens)
+    _filter_logits; distributional parity is its own test tier). The
+    run itself is the module-shared plain_sampling_outputs fixture —
+    the same run is the speculative parity test's oracle."""
+    cfg, _ = tiny_model
+    assert len(plain_sampling_outputs) == 3
+    for out, p in zip(plain_sampling_outputs, sampling_prompts):
+        gen = out[p.shape[0]:]
+        assert gen.shape[0] == 5
+        assert all(0 <= t < cfg.vocab_size for t in gen)
 
 
 def test_engine_rejects_oversize_and_bad_strategy(tiny_model):
@@ -126,6 +144,118 @@ def test_engine_rejects_oversize_and_bad_strategy(tiny_model):
                       max_new_tokens=8)
     with pytest.raises(ValueError, match="greedy|sampling"):
         ServingEngine(model, decode_strategy="beam")
+
+
+# ------------------------------------------------ speculative arm
+def test_spec_engine_greedy_oracle_ragged_eos(tiny_model, tiny_draft):
+    """ISSUE 3 acceptance: the greedy speculative round is EXACT BY
+    CONSTRUCTION — an arbitrary independent (near-floor-acceptance)
+    draft leaves the served outputs bit-identical to target-only
+    sequential generate, under ragged arrivals over fewer slots
+    (retirement + slot/block reuse mid-run) with device-computed eos
+    truncating the round's variable yield in-graph. Prompt shapes
+    match the plain-engine eos test so the sequential oracle compiles
+    are cache hits."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(1)
+    probe = rng.randint(1, cfg.vocab_size, 6).astype(np.int32)
+    row = _oracle_row(model, probe, 10)
+    eos = int(row[6 + 3])  # the 4th greedy token becomes "eos"
+    prompts = [probe,
+               rng.randint(1, cfg.vocab_size, 4).astype(np.int32),
+               rng.randint(1, cfg.vocab_size, 8).astype(np.int32)]
+    engine = ServingEngine(model, spec_draft=tiny_draft, spec_gamma=2,
+                           num_slots=2, block_size=4, prefill_chunk=3,
+                           eos_token_id=eos)
+    reqs = [engine.submit(p, max_new_tokens=10) for p in prompts]
+    done = engine.run()
+    assert len(done) == len(reqs)
+    assert reqs[0].finish_reason == "eos"
+    for req, p in zip(reqs, prompts):
+        np.testing.assert_array_equal(
+            engine.output_tokens(req),
+            _oracle_row(model, p, 10, eos_token_id=eos))
+    st = engine.engine_stats()
+    assert st["spec_rounds"] > 0
+    assert st["spec_proposed"] >= st["spec_accepted"] >= 0
+    # retirement drains BOTH pools back to their scratch block
+    assert engine.pool.fragmentation_stats()["blocks_in_use"] == 1
+    assert engine.d_pool.fragmentation_stats()["blocks_in_use"] == 1
+
+
+_SAMPLING_KW = dict(num_slots=2, block_size=4, prefill_chunk=4,
+                    decode_strategy="sampling", top_k=8,
+                    temperature=0.9)
+
+
+@pytest.fixture(scope="module")
+def sampling_prompts(tiny_model):
+    cfg, _ = tiny_model
+    rng = np.random.RandomState(2)
+    return [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+            for n in (5, 7, 3)]
+
+
+@pytest.fixture(scope="module")
+def plain_sampling_outputs(tiny_model, sampling_prompts):
+    """One PLAIN sampling-engine run (max_new 5, per-request seed i)
+    shared by the smoke test and the speculative parity oracle — one
+    compile, one execution."""
+    _, model = tiny_model
+    engine = ServingEngine(model, decode_quantum=3, **_SAMPLING_KW)
+    reqs = [engine.submit(p, max_new_tokens=5, seed=i)
+            for i, p in enumerate(sampling_prompts)]
+    engine.run()
+    assert len(engine.completed) == len(reqs)
+    return [engine.output_tokens(r) for r in reqs]
+
+
+def test_spec_engine_sampling_parity_fixed_seeds(tiny_model,
+                                                 sampling_prompts,
+                                                 plain_sampling_outputs):
+    """Rejection-sampling arm with draft == target: q == p, so every
+    proposal accepts, and the fold_in(key, n_emitted) token-stream
+    discipline makes the speculative engine replay the PLAIN sampling
+    engine's output bit-for-bit on fixed seeds — the deterministic
+    oracle the sampling arm has (the greedy arm's is sequential
+    generate)."""
+    cfg, model = tiny_model
+    spec = ServingEngine(model, spec_draft=model, spec_gamma=2,
+                         **_SAMPLING_KW)
+    reqs = [spec.submit(p, max_new_tokens=5, seed=i)
+            for i, p in enumerate(sampling_prompts)]
+    spec.run()
+    for req, want in zip(reqs, plain_sampling_outputs):
+        np.testing.assert_array_equal(spec.output_tokens(req), want)
+    st = spec.engine_stats()
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] == st["spec_proposed"]  # q == p
+
+
+@pytest.mark.slow
+def test_speculative_generate_facade(tiny_model, tiny_draft):
+    """nlp.generation.speculative_generate: batch rows ride serving
+    slots; greedy output equals target-only generate row-for-row."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(0)
+    prompts = np.stack([rng.randint(1, cfg.vocab_size, 5)
+                        .astype(np.int32) for _ in range(2)])
+    out, rate = speculative_generate(model, tiny_draft, prompts,
+                                     max_new_tokens=6, gamma=3)
+    out = np.asarray(out._value)
+    for i in range(2):
+        np.testing.assert_array_equal(out[i],
+                                      _oracle_row(model, prompts[i], 6))
+    assert 0.0 <= rate <= 1.0
+
+
+def test_spec_engine_rejects_bad_draft(tiny_model, tiny_draft):
+    cfg, model = tiny_model
+    with pytest.raises(ValueError, match="vocab"):
+        ServingEngine(model, spec_draft=LlamaForCausalLM(
+            LlamaConfig.tiny(tensor_parallel=False, vocab_size=64)))
+    with pytest.raises(ValueError, match="spec_gamma"):
+        ServingEngine(model, spec_draft=tiny_draft, spec_gamma=0)
 
 
 # ------------------------------------------------ pool lifecycle
@@ -217,6 +347,32 @@ def test_scheduler_admission_gating():
         sched.try_admit()
 
 
+def test_scheduler_companion_pool_and_margin():
+    """Speculative admission accounts for the DRAFT pool too: capacity
+    gates on the tightest pool, demand carries the γ token margin (the
+    verify step's worst-case writes), and retirement frees blocks in
+    every pool."""
+    pool = _pool(num_blocks=8, bs=4)
+    d_pool = _pool(num_blocks=4, bs=4)  # the tighter pool gates
+    sched = Scheduler(SchedulerConfig(num_slots=4), pool,
+                      companion_pools=[d_pool], token_margin=3)
+    a = sched.submit(Request(np.arange(1, 6), max_new_tokens=8))
+    # demand = ceil((5 + 8 + 3) / 4) = 4 blocks — fills d_pool exactly
+    assert sched.try_admit() == [a]
+    assert sched.reserved_blocks == 4
+    b = sched.submit(Request(np.arange(1, 3), max_new_tokens=2))
+    assert sched.try_admit() == []      # draft-pool capacity exhausted
+    pool.ensure(a.req_id, 5)
+    d_pool.ensure(a.req_id, 5)
+    a.finished = True
+    sched.retire(a)                      # frees BOTH pools
+    assert pool.blocks_in_use == 0 and d_pool.blocks_in_use == 0
+    assert sched.try_admit() == [b]
+    with pytest.raises(ValueError, match="block_size"):
+        Scheduler(SchedulerConfig(), pool,
+                  companion_pools=[_pool(bs=8)])
+
+
 # ------------------------------------------------ the analysis budget
 def test_serving_decode_step_budget():
     """The machine-checked single-dispatch invariant (ISSUE 2
@@ -230,3 +386,19 @@ def test_serving_decode_step_budget():
     assert report.host_sync is not None and report.host_sync.count == 0
     assert report.total_collectives == 0
     assert report.donation.undonated() == []
+
+
+def test_speculative_verify_step_budget():
+    """ISSUE 3 acceptance: the EXACT speculative round the engine
+    dispatches — draft-γ scan + target verify + in-graph acceptance —
+    has zero involuntary remat, zero host callbacks/transfers, no
+    collectives, bf16 stays bf16, and BOTH pools' KV leaves (2L_target
+    + 2L_draft) are donated."""
+    from paddle_tpu import analysis
+
+    report = analysis.run_recipe("speculative_verify_step")
+    assert len(report.remat_events) == 0
+    assert report.host_sync is not None and report.host_sync.count == 0
+    assert report.total_collectives == 0
+    assert report.donation.undonated() == []
+    assert report.donation.n_donatable == 6  # 2*2 target + 2*1 draft
