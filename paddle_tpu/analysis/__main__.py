@@ -3,14 +3,21 @@
     python -m paddle_tpu.analysis                    # audit every recipe
     python -m paddle_tpu.analysis --recipe NAME      # just one
     python -m paddle_tpu.analysis --check            # enforce budgets
+    python -m paddle_tpu.analysis --fingerprint      # compare goldens
+    python -m paddle_tpu.analysis --update-goldens   # regenerate them
     python -m paddle_tpu.analysis --json             # machine-readable
 
 Audits the registered recipes (see .recipes) — lowering + compiling
 each program and printing the collective census, remat events, dtype
-findings, and donation coverage. ``--check`` additionally enforces each
-recipe's budget and exits non-zero on any violation (the bench-suite /
-CI entry point). Source linting is the sibling CLI:
-``python -m paddle_tpu.analysis.lint paddle_tpu/``.
+findings, donation coverage, memory estimate, and sharding layout.
+``--check`` additionally enforces each recipe's budget and
+``--fingerprint`` compares each live fingerprint against its golden
+(tests/goldens/<recipe>.json, or ``--goldens-dir``); either exits
+non-zero on a violation/drift (the bench-suite / CI entry point —
+scripts/check_graphs.sh runs both plus the linter). After an
+INTENTIONAL graph change run ``--update-goldens`` and review the
+goldens' git diff. Source linting is the sibling CLI:
+``python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/``.
 """
 from __future__ import annotations
 
@@ -21,18 +28,21 @@ import sys
 
 from . import recipes
 from .budget import BudgetViolation
-from .collectives import COLLECTIVE_KINDS
+from .fingerprint import (
+    FingerprintMismatch, check_recipe_fingerprint, fingerprint_report,
+    save_golden,
+)
 
 
-def _report_json(name, report, ok, violations):
-    return {
+def _report_json(name, report, ok, violations, fp_status=None):
+    out = {
         "recipe": name,
         "budget_ok": ok,
         "violations": violations,
         "collectives": {
             k: {"count": report.collectives[k].count,
                 "bytes": report.collectives[k].bytes}
-            for k in COLLECTIVE_KINDS
+            for k in sorted(report.collectives)
         },
         "involuntary_remat": len(report.remat_events),
         "f32_matmuls_from_bf16": (
@@ -43,6 +53,16 @@ def _report_json(name, report, ok, violations):
         "donated_args": report.donation.donated_count,
         "undonated_donatable_bytes": report.donation.undonated_bytes,
     }
+    if report.memory is not None:
+        out["memory"] = {
+            "compiler": report.memory.compiler,
+            "peak_live_bytes": report.memory.peak_live_bytes,
+        }
+    if report.sharding is not None:
+        out["sharding"] = report.sharding.summary_dict()
+    if fp_status is not None:
+        out["fingerprint"] = fp_status
+    return out
 
 
 _REEXEC_GUARD = "_PADDLE_TPU_ANALYSIS_REEXEC"
@@ -79,8 +99,18 @@ def main(argv=None):
     ap.add_argument("--check", action="store_true",
                     help="enforce each recipe's budget; exit 1 on any "
                          "violation")
+    ap.add_argument("--fingerprint", action="store_true",
+                    help="compare each recipe's live fingerprint "
+                         "against its checked-in golden; exit 1 on "
+                         "drift")
+    ap.add_argument("--update-goldens", action="store_true",
+                    help="write each audited recipe's fingerprint as "
+                         "the new golden (review the git diff!)")
+    ap.add_argument("--goldens-dir", default=None,
+                    help="golden directory (default: tests/goldens)")
     ap.add_argument("--json", action="store_true",
-                    help="one JSON object per recipe on stdout")
+                    help="one JSON object per recipe on stdout "
+                         "(sorted keys)")
     args = ap.parse_args(argv)
 
     names = args.recipe or sorted(recipes.RECIPES)
@@ -99,15 +129,42 @@ def main(argv=None):
                     failures += 1
             else:
                 report = recipe.audit()
+
+            fp_status, fp_diff = None, []
+            if args.update_goldens:
+                path = save_golden(
+                    fingerprint_report(report, name=name), name,
+                    goldens_dir=args.goldens_dir)
+                fp_status = f"golden updated: {path}"
+            elif args.fingerprint:
+                try:
+                    check_recipe_fingerprint(
+                        name, report, goldens_dir=args.goldens_dir)
+                    fp_status = "ok"
+                except FingerprintMismatch as e:
+                    fp_status = "drift"
+                    fp_diff = e.diff
+                    failures += 1
+
             if args.json:
-                print(json.dumps(_report_json(name, report, ok,
-                                              violations)))
+                print(json.dumps(
+                    _report_json(
+                        name, report, ok, violations,
+                        fp_status=(fp_status if not fp_diff else
+                                   {"status": fp_status,
+                                    "diff": fp_diff})),
+                    sort_keys=True))
             else:
                 print(report.summary())
                 if args.check:
                     print(f"  budget [{recipe.budget.name}]: "
                           + ("OK" if ok else "VIOLATED"))
                     for ln in violations:
+                        print(f"    ! {ln}")
+                if fp_status is not None:
+                    print(f"  fingerprint: "
+                          + ("OK" if fp_status == "ok" else fp_status))
+                    for ln in fp_diff:
                         print(f"    ! {ln}")
                 print()
         finally:

@@ -22,6 +22,8 @@ from .remat import detect_involuntary_remat
 from .dtypes import audit_dtype_promotion, DtypeReport
 from .donation import audit_donation
 from .hostsync import host_sync_census
+from .memory import analyze_memory
+from .sharding import audit_sharding
 
 __all__ = ["Budget", "BudgetViolation", "AuditReport", "audit",
            "check_budget"]
@@ -31,6 +33,8 @@ _BUDGET_FIELDS = (
     "max_reduce_scatters", "max_all_to_alls", "max_collective_permutes",
     "max_total_collectives", "max_collective_bytes", "max_f32_matmuls",
     "max_f32_upcasts", "max_undonated_bytes", "max_host_callbacks",
+    "max_temp_bytes", "max_peak_live_bytes", "max_output_bytes",
+    "max_replicated_param_bytes", "min_sharded_params",
     "require_donated", "require_reduce_scatter", "require_all_gather",
 )
 
@@ -62,7 +66,21 @@ class Budget:
         max_host_callbacks: python-callback custom-calls plus
             infeed/outfeed/host send-recv ops in the compiled module
             (0 = the no-host-sync-inside-the-loop serving invariant).
+        max_temp_bytes: XLA's buffer-assignment temp allocation for
+            the compiled program (``compiled.memory_analysis()``;
+            backend-shaped — pin per backend).
+        max_output_bytes: XLA's output allocation (aliased/donated
+            output bytes don't cost extra HBM; this caps the rest).
+        max_peak_live_bytes: peak live bytes of the jaxpr liveness
+            walk — backend-independent, drifts exactly when the traced
+            graph drifts (a lost donation, a ballooned intermediate).
+        max_replicated_param_bytes: no fully-replicated donatable leaf
+            (param/state/buffer) above this many bytes — norm scales
+            may replicate by design, weight matrices/moments may not.
     Requirements:
+        min_sharded_params: at least this many donatable leaves carry
+            a real (non-replicated) sharding — the ZeRO/TP axis is
+            present on the state, not just intended.
         require_donated: every donatable arg must be donated.
         require_reduce_scatter: the stage-2 ZeRO pattern (fused
             reduce-scatter, or the CPU backend's all-reduce +
@@ -107,7 +125,7 @@ class AuditReport:
     """Structured result of every pass over one compiled program."""
 
     def __init__(self, name, collectives, remat_events, dtype_report,
-                 donation, host_sync=None):
+                 donation, host_sync=None, memory=None, sharding=None):
         self.name = name
         #: dict kind -> CollectiveStats
         self.collectives = collectives
@@ -119,6 +137,10 @@ class AuditReport:
         self.donation = donation
         #: HostSyncStats (callbacks + host transfers in compiled HLO)
         self.host_sync = host_sync
+        #: MemoryReport (compiler buffer stats + jaxpr liveness)
+        self.memory = memory
+        #: ShardingReport (per-arg layouts from StableHLO attrs)
+        self.sharding = sharding
 
     @property
     def total_collectives(self):
@@ -129,9 +151,12 @@ class AuditReport:
         return sum(s.bytes for s in self.collectives.values())
 
     def summary(self):
+        # every multi-entry section iterates in SORTED order so the
+        # text is identical run-to-run regardless of dict insertion
+        # order (fingerprint diffs and capfd tests depend on this)
         lines = [f"audit: {self.name}"]
         lines.append("  collectives:")
-        for kind in COLLECTIVE_KINDS:
+        for kind in sorted(self.collectives):
             st = self.collectives[kind]
             if st.count:
                 lines.append(
@@ -160,6 +185,12 @@ class AuditReport:
             + (f"; {len(d.undonated())} donatable args UNDONATED "
                f"({d.undonated_bytes:,} B)"
                if d.n_donatable is not None else ""))
+        if self.memory is not None:
+            lines.extend(self.memory.summary_lines())
+        if self.sharding is not None:
+            s = self.sharding.summary_dict()
+            lines.append("  sharding: " + ", ".join(
+                f"{k} {s[k]}" for k in sorted(s)))
         return "\n".join(lines)
 
 
@@ -177,11 +208,16 @@ def audit(target, *args, **kwargs):
         jaxpr = None
     dtype_report = (audit_dtype_promotion(jaxpr)
                     if jaxpr is not None else None)
-    donation = audit_donation(lt.stablehlo_text(),
-                              n_donatable=lt.n_donatable)
+    stablehlo = lt.stablehlo_text()
+    donation = audit_donation(stablehlo, n_donatable=lt.n_donatable)
     host_sync = host_sync_census(hlo)
+    memory = analyze_memory(
+        lt, donated_indices=[a.index for a in donation.args
+                             if a.donated], jaxpr=jaxpr)
+    sharding = audit_sharding(stablehlo, n_donatable=lt.n_donatable)
     report = AuditReport(lt.name, census, remat_events, dtype_report,
-                         donation, host_sync=host_sync)
+                         donation, host_sync=host_sync, memory=memory,
+                         sharding=sharding)
     report.hlo_text = hlo  # kept for pattern checks (reduce-scatter)
     return report
 
@@ -219,6 +255,37 @@ def check_budget(target, budget, *args, **kwargs):
     if report.host_sync is not None:
         cap(budget.max_host_callbacks, report.host_sync.count,
             "host callbacks/transfers in compiled module")
+
+    mem = report.memory
+    for limit, what, actual in (
+            (budget.max_temp_bytes, "compiled temp bytes",
+             None if mem is None else mem.temp_bytes),
+            (budget.max_output_bytes, "compiled output bytes",
+             None if mem is None else mem.output_bytes),
+            (budget.max_peak_live_bytes, "jaxpr peak live bytes",
+             None if mem is None else mem.peak_live_bytes)):
+        if limit is None:
+            continue
+        if actual is None:
+            v.append(f"{what} budget set but the target offers no "
+                     "view to measure it")
+        else:
+            cap(limit, actual, what)
+
+    sh = report.sharding
+    if budget.max_replicated_param_bytes is not None and sh is not None:
+        offenders = sh.replicated_params(
+            min_bytes=budget.max_replicated_param_bytes + 1)
+        if offenders:
+            v.append(
+                f"replicated donatable leaves above "
+                f"{budget.max_replicated_param_bytes} B: "
+                f"{offenders[:3]}")
+    if budget.min_sharded_params is not None and sh is not None \
+            and sh.sharded_param_count < budget.min_sharded_params:
+        v.append(f"sharded donatable leaves: "
+                 f"{sh.sharded_param_count} < budget minimum "
+                 f"{budget.min_sharded_params}")
     if budget.require_donated:
         und = report.donation.undonated()
         if report.donation.n_donatable is None:

@@ -1,0 +1,179 @@
+"""Graph fingerprints: the golden drift gate over every audited recipe.
+
+A fingerprint is the canonical, deterministic scalar summary of one
+compiled recipe — collective op counts and byte volumes, involuntary
+remat events, donation coverage, dtype taints, host syncs, both memory
+views, and the sharding layout summary — serialized (sorted keys,
+stable types) to ``tests/goldens/<recipe>.json``. Tier-1 compares the
+live audit of each registered recipe against its checked-in golden, so
+*any* silent graph drift — an extra collective, a lost donation, a
+replicated param, ballooned peak memory — fails with a field-level
+diff even when every numeric test stays green.
+
+Workflow:
+
+- a recipe changed ON PURPOSE: regenerate with
+  ``python -m paddle_tpu.analysis --update-goldens`` (optionally
+  ``--recipe NAME``), eyeball the git diff of the golden (it IS the
+  review artifact: each changed field is one graph property), commit.
+- a recipe changed by ACCIDENT: the tier-1 gate / ``--fingerprint``
+  CLI / ``scripts/check_graphs.sh`` prints the per-field diff; fix the
+  regression instead.
+
+Goldens are pinned to the tier-1 backend (the 8-virtual-device CPU
+platform tests/conftest.py forces): compiler memory numbers and
+collective lowering are backend-shaped, so a device run maintains its
+own golden set via ``--goldens-dir``.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = [
+    "FINGERPRINT_VERSION", "FingerprintMismatch", "GOLDENS_DIR",
+    "check_recipe_fingerprint", "compare_fingerprint",
+    "fingerprint_report", "golden_path", "load_golden", "save_golden",
+]
+
+FINGERPRINT_VERSION = 1
+
+#: default golden directory: tests/goldens next to the package
+GOLDENS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "goldens")
+
+
+class FingerprintMismatch(AssertionError):
+    """The live fingerprint drifted from the golden; ``diff`` is the
+    list of human-readable per-field lines."""
+
+    def __init__(self, name, diff):
+        self.diff = list(diff)
+        super().__init__(
+            f"fingerprint {name!r}: {len(self.diff)} field(s) drifted "
+            f"from golden\n  - " + "\n  - ".join(self.diff)
+            + "\n(intentional change? regenerate with `python -m "
+            "paddle_tpu.analysis --update-goldens` and review the "
+            "golden's git diff)")
+
+
+def fingerprint_report(report, name=""):
+    """Canonical fingerprint dict for one
+    :class:`~.budget.AuditReport`. Every field is a JSON scalar or a
+    dict of them; list-valued census results are reduced to sorted
+    counts so the fingerprint is insertion-order-independent."""
+    fp = {
+        "version": FINGERPRINT_VERSION,
+        "recipe": name or report.name,
+        "collectives": {
+            kind: {"count": st.count, "bytes": st.bytes}
+            for kind, st in sorted(report.collectives.items())
+        },
+        "involuntary_remat": len(report.remat_events),
+        "donation": {
+            "n_args": len(report.donation.args),
+            "donated": report.donation.donated_count,
+            "n_donatable": report.donation.n_donatable,
+            "undonated_bytes": report.donation.undonated_bytes,
+        },
+        "dtype": None if report.dtype is None else {
+            "f32_matmuls": len(report.dtype.f32_compute),
+            "upcasts": report.dtype.upcasts,
+        },
+        "host_sync": None if report.host_sync is None else {
+            "callbacks": sorted(report.host_sync.callbacks),
+            "transfers": sorted(report.host_sync.transfers),
+        },
+    }
+    mem = getattr(report, "memory", None)
+    fp["memory"] = None if mem is None else {
+        "compiler": (None if mem.compiler is None
+                     else dict(sorted(mem.compiler.items()))),
+        "liveness": None if mem.liveness is None else {
+            "peak_live_bytes": mem.liveness.peak_live_bytes,
+            "largest_buffer_bytes":
+                mem.liveness.largest_buffer_bytes,
+            "donation_savings_bytes":
+                mem.liveness.donation_savings_bytes,
+            "input_bytes": mem.liveness.input_bytes,
+            "output_bytes": mem.liveness.output_bytes,
+        },
+    }
+    sh = getattr(report, "sharding", None)
+    fp["sharding"] = None if sh is None else sh.summary_dict()
+    return fp
+
+
+def _flatten(d, prefix=""):
+    """dict-of-dicts -> {"a.b.c": leaf}; lists stay leaf values."""
+    if not isinstance(d, dict):
+        return {prefix[:-1]: d}
+    out = {}
+    for k in sorted(d):
+        out.update(_flatten(d[k], f"{prefix}{k}."))
+    return out
+
+
+def compare_fingerprint(golden, current):
+    """Field-level diff between two fingerprint dicts; returns a list
+    of human-readable lines, empty when they match. Numeric drifts
+    show the delta so an all-gather-count bump reads at a glance."""
+    g, c = _flatten(golden), _flatten(current)
+    lines = []
+    for key in sorted(set(g) | set(c)):
+        if key == "recipe":
+            continue  # identity, not a graph property
+        gv, cv = g.get(key, "<absent>"), c.get(key, "<absent>")
+        if gv == cv:
+            continue
+        delta = ""
+        if isinstance(gv, (int, float)) and isinstance(cv, (int, float)) \
+                and not isinstance(gv, bool) and not isinstance(cv, bool):
+            delta = f" ({'+' if cv >= gv else ''}{cv - gv})"
+        lines.append(f"{key}: golden {gv!r} != current {cv!r}{delta}")
+    return lines
+
+
+def golden_path(name, goldens_dir=None):
+    return os.path.join(goldens_dir or GOLDENS_DIR, f"{name}.json")
+
+
+def load_golden(name, goldens_dir=None):
+    """The checked-in fingerprint for ``name`` (None when no golden
+    exists yet — the gate then tells you to create one)."""
+    path = golden_path(name, goldens_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_golden(fp, name, goldens_dir=None):
+    """Write (sorted keys, 2-space indent, trailing newline — byte-
+    stable for git) and return the path."""
+    path = golden_path(name, goldens_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(fp, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_recipe_fingerprint(name, report, goldens_dir=None):
+    """Compare ``report``'s fingerprint against the checked-in golden
+    for recipe ``name``; returns the fingerprint on match, raises
+    :class:`FingerprintMismatch` (with the per-field diff) on drift or
+    a missing golden. The tier-1 hook every recipe test calls with the
+    report it already audited — no extra compile."""
+    fp = fingerprint_report(report, name=name)
+    golden = load_golden(name, goldens_dir)
+    if golden is None:
+        raise FingerprintMismatch(
+            name, [f"no golden at {golden_path(name, goldens_dir)} "
+                   f"(create it: python -m paddle_tpu.analysis "
+                   f"--update-goldens --recipe {name})"])
+    diff = compare_fingerprint(golden, fp)
+    if diff:
+        raise FingerprintMismatch(name, diff)
+    return fp

@@ -82,6 +82,12 @@ class LoweredTarget:
         self._ensure_compiled()
         return self._compiled.as_text()
 
+    def compiled(self):
+        """The compiled executable itself (memory_analysis lives
+        here)."""
+        self._ensure_compiled()
+        return self._compiled
+
     def compile_stderr(self):
         """Everything XLA wrote to fd 2 while compiling this target
         (the remat detector greps it)."""

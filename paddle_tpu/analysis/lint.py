@@ -2,8 +2,8 @@
 
 Usage::
 
-    python -m paddle_tpu.analysis.lint paddle_tpu/ [more paths...]
-        [--allowlist FILE] [--no-default-allowlist]
+    python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/ [...]
+        [--allowlist FILE] [--no-default-allowlist] [--allow-stale]
 
 The linter finds **syntactic jit scopes** — functions decorated with
 ``@jax.jit`` / ``@to_static`` / ``partial(jax.jit, ...)``, functions (or
@@ -34,9 +34,10 @@ scope detection is lexical per module — a module-level helper that is
 only CALLED from inside a jitted closure is not scanned (no
 inter-procedural call graph), and taint does not flow through
 attribute stores or container mutation. The repo gate in
-tests/test_analysis_lint.py runs this over ``paddle_tpu/`` with the
-checked-in allowlist next to this file, so every NEW hazard fails
-tier-1.
+tests/test_analysis_lint.py runs this over ``paddle_tpu/`` AND
+``scripts/`` with the checked-in allowlist next to this file, so every
+NEW hazard fails tier-1 — and stale allowlist entries fail it too (by
+default; ``--allow-stale`` opts out), so the list can only shrink.
 """
 from __future__ import annotations
 
@@ -527,7 +528,11 @@ def main(argv=None):
                          "paddle_tpu/analysis/lint_allowlist.txt)")
     ap.add_argument("--no-default-allowlist", action="store_true")
     ap.add_argument("--strict-allowlist", action="store_true",
-                    help="fail on stale (unused) allowlist entries")
+                    help="(default) fail on stale allowlist entries")
+    ap.add_argument("--allow-stale", action="store_true",
+                    help="tolerate stale (unused) allowlist entries; "
+                         "by default they fail the lint so the "
+                         "allowlist can only shrink")
     args = ap.parse_args(argv)
 
     allow = {}
@@ -541,10 +546,12 @@ def main(argv=None):
     for v in violations:
         print(v)
     if unused:
-        print(f"note: {len(unused)} stale allowlist entr"
-              f"{'y' if len(unused) == 1 else 'ies'}: "
+        print(f"{'note' if args.allow_stale else 'error'}: "
+              f"{len(unused)} stale allowlist entr"
+              f"{'y' if len(unused) == 1 else 'ies'} (allowlisted "
+              f"hazard no longer exists — delete the line): "
               + ", ".join(unused), file=sys.stderr)
-    if violations or (unused and args.strict_allowlist):
+    if violations or (unused and not args.allow_stale):
         print(f"{len(violations)} tracer hazard(s) found",
               file=sys.stderr)
         return 1

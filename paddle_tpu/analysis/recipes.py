@@ -28,7 +28,11 @@ known-good graph shape.
 ``build(name)`` constructs the recipe (installing the mesh it needs)
 and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
 report and ``recipe.close()`` (or use ``run(name)``) to restore global
-mesh state. Used by tests/test_zero_ir.py, tests/test_analysis.py, the
+mesh state. Every registered recipe also carries a checked-in golden
+fingerprint (``tests/goldens/<name>.json``, see :mod:`.fingerprint`)
+compared against the live audit in tier-1 and by ``--fingerprint`` /
+``scripts/check_graphs.sh``. Used by tests/test_zero_ir.py,
+tests/test_analysis.py, tests/test_serving.py, the
 ``python -m paddle_tpu.analysis`` CLI, and scripts/bench_suite.py.
 """
 from __future__ import annotations
@@ -107,6 +111,15 @@ def _build_llama_tp_zero_fused_lce():
         # regression (per-layer re-gather, lost fusion) blows through it
         max_all_gathers=80,
         max_f32_matmuls=0,
+        # audited 4.37 MB trace-level peak; a lost donation or a
+        # full-logits buffer reappearing blows through the headroom
+        max_peak_live_bytes=6_000_000,
+        # norm scales (256 B) replicate by design; any 2-D leaf —
+        # a weight or its moments — losing its TP/ZeRO axis is >4 KB
+        max_replicated_param_bytes=4096,
+        # 48 sharded leaves audited: params + both moments actually
+        # carry the axis, not just the sharding rule table
+        min_sharded_params=40,
     )
     return Recipe("llama_tp_zero_fused_lce", step, (ids, ids), budget,
                   teardown=_mesh_teardown())
@@ -141,6 +154,11 @@ def _build_llama_decode_greedy():
         max_total_collectives=0,  # single-chip program: any collective
                                   # means an accidental mesh dependency
         max_f32_matmuls=0,        # bf16 serving graph stays bf16
+        # audited 22.9 KB temp / 64 B output on the tier-1 backend: a
+        # decode loop that starts materializing per-step logits or
+        # full-cache copies is a structural regression
+        max_temp_bytes=64_000,
+        max_output_bytes=1024,
     )
     return Recipe("llama_decode_greedy", jitted, args, budget)
 
@@ -168,6 +186,11 @@ def _build_serving_decode_step():
         max_f32_matmuls=0,        # bf16 pool/params stay bf16
         max_host_callbacks=0,     # host scheduler only at boundaries
         require_donated=True,     # the 2L KV pool leaves
+        # audited 207 KB temp / 891 KB trace peak: the quantum works
+        # in-place over the donated pool — a lost donation or an
+        # unrolled scan materializing per-token buffers blows this
+        max_temp_bytes=300_000,
+        max_peak_live_bytes=1_300_000,
     )
     return Recipe("serving_decode_step", target, args, budget)
 
@@ -198,6 +221,10 @@ def _build_speculative_verify_step():
         max_f32_matmuls=0,        # bf16 pools/params stay bf16
         max_host_callbacks=0,     # host scheduler only at boundaries
         require_donated=True,     # draft AND target KV pool leaves
+        # audited 229 KB temp / 1.38 MB trace peak (draft + target
+        # pools both in flight; donation saves 402 KB of that)
+        max_temp_bytes=330_000,
+        max_peak_live_bytes=2_000_000,
     )
     return Recipe("speculative_verify_step", step, args, budget)
 

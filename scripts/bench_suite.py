@@ -6,7 +6,8 @@ line per config; results are recorded in BENCH_NOTES.md.
 
     PYTHONPATH=. python scripts/bench_suite.py [config ...]
 
-Configs: resnet50_eager | resnet50_jit | gpt2_jit | ernie_engine |
+Configs: graph_audit | graph_fingerprint | resnet50_eager |
+resnet50_jit | gpt2_jit | ernie_engine |
 sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
@@ -897,6 +898,35 @@ def graph_audit():
                                   for k, v in rows.items()}}
 
 
+def graph_fingerprint():
+    """Golden drift gate for the audited recipes: compare each live
+    fingerprint (collectives, remat, donation, dtype, host syncs,
+    memory, sharding) against tests/goldens/<recipe>.json. Drift
+    raises — a perf number measured on a silently-drifted graph is not
+    comparable to the history, so the suite fails loudly first."""
+    from paddle_tpu import analysis
+
+    drifted = {}
+    checked = 0
+    for name in sorted(analysis.RECIPES):
+        recipe = analysis.build_recipe(name)
+        try:
+            report = recipe.audit()
+        finally:
+            recipe.close()
+        try:
+            analysis.check_recipe_fingerprint(name, report)
+            checked += 1
+        except analysis.FingerprintMismatch as e:
+            drifted[name] = e.diff
+    if drifted:
+        raise analysis.FingerprintMismatch(
+            "+".join(sorted(drifted)),
+            [ln for diff in drifted.values() for ln in diff])
+    return {"metric": "graph_fingerprint_goldens_ok", "value": checked,
+            "unit": "recipes"}
+
+
 def _bench_serving():
     """Import scripts/bench_serving.py wherever the suite is run from
     (same trick as _bench for the repo-root driver)."""
@@ -933,6 +963,7 @@ def speculative_serving():
 
 CONFIGS = {
     "graph_audit": graph_audit,
+    "graph_fingerprint": graph_fingerprint,
     "serving_engine": serving_engine,
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,

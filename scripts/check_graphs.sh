@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Pre-merge static gate: tracer-hazard lint + graph-budget audit +
+# golden-fingerprint compare over every registered recipe. Exits
+# non-zero on any hazard, budget violation, stale allowlist entry, or
+# fingerprint drift. Run from anywhere; ~1 min on the CPU backend.
+#
+#     scripts/check_graphs.sh
+#
+# After an INTENTIONAL graph change: regenerate the goldens with
+# `python -m paddle_tpu.analysis --update-goldens`, review their git
+# diff, and re-run this gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}."
+
+python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/
+python -m paddle_tpu.analysis --check --fingerprint
+echo "check_graphs: lint + budgets + fingerprints all green"

@@ -1,11 +1,15 @@
-"""paddle_tpu.analysis — graph auditor + budget mechanism.
+"""paddle_tpu.analysis — graph auditor + budget mechanism + golden
+fingerprint drift gate.
 
 Each IR pass gets a KNOWN-BAD function it must flag and a KNOWN-CLEAN
-function it must not, plus the two registered real-recipe budgets
-(the TP x ZeRO fused-LCE train step and the on-device greedy decode)
+function it must not, plus the registered real-recipe budgets AND
+their checked-in golden fingerprints (tests/goldens/<recipe>.json)
 which must hold on the current code — these are the machine-checked
 "did not regress the compiled graph" guarantees every future perf PR
-inherits."""
+inherits. The serving recipes' budget+fingerprint gates live in
+tests/test_serving.py next to the engine tests; the CLI (--check /
+--fingerprint, success and failure paths) is exercised end-to-end
+here."""
 import numpy as np
 import pytest
 import jax
@@ -227,23 +231,228 @@ def test_budget_violations_aggregate():
 def test_recipe_budget_tp_zero_fused_lce():
     """The round-5 hybrid recipe compiles within its declared budget:
     0 involuntary remats, the stage-2 reduce-scatter decision present,
-    every param/state/buffer leaf donated, bounded all-gather count."""
+    every param/state/buffer leaf donated, bounded all-gather count,
+    peak live bytes capped, no replicated weight leaves — and the full
+    fingerprint matches the checked-in TP2 x ZeRO golden (same report,
+    no extra compile)."""
     report = analysis.run_recipe("llama_tp_zero_fused_lce")
     assert report.remat_events == []
     assert report.collectives["all-gather"].count > 0  # TP really talks
     assert report.donation.undonated() == []
+    # the sharding pass sees the layout: params + moments carry a real
+    # axis, only the 1-D norm scales (256 B) replicate
+    assert report.sharding.sharded_param_count >= 40
+    assert report.sharding.max_replicated_param_bytes <= 4096
+    analysis.check_recipe_fingerprint("llama_tp_zero_fused_lce", report)
 
 
 def test_recipe_budget_decode_greedy():
     """The single-chip bf16 serving loop: no collectives (any would be
-    an accidental mesh dependency) and the bf16 graph stays bf16."""
+    an accidental mesh dependency), the bf16 graph stays bf16, temp and
+    output allocations stay tiny — and the fingerprint matches its
+    golden."""
     report = analysis.run_recipe("llama_decode_greedy")
     assert report.total_collectives == 0
     assert report.dtype is not None
     assert report.dtype.f32_compute == []
+    assert report.memory.temp_bytes is not None
+    analysis.check_recipe_fingerprint("llama_decode_greedy", report)
 
 
 def test_audit_summary_is_printable():
     report = analysis.audit(lambda a: a * 2, jnp.ones((4,)))
     text = report.summary()
     assert "collectives" in text and "remat" in text
+    assert "memory" in text and "sharding" in text
+
+
+def test_audit_summary_is_dict_order_independent():
+    """The summary text must not depend on dict insertion order —
+    fingerprint diffs and capfd assertions read it verbatim."""
+    report = analysis.audit(lambda a: a * 2, jnp.ones((4,)))
+    base = report.summary()
+    report.collectives = dict(
+        sorted(report.collectives.items(), reverse=True))
+    assert report.summary() == base
+
+
+# ---------------------------------------------------------------- memory
+
+def test_liveness_walk_donation_savings():
+    """A donated input that dies early shrinks peak live bytes; an
+    undonated one is held for the whole program."""
+    from paddle_tpu.analysis import jaxpr_liveness
+
+    def f(p, g):
+        a = p * 2.0          # p's last use: dies here if donated
+        b = a + g
+        c = b * g
+        return c
+
+    args = (jnp.ones((256, 256)), jnp.ones((256, 256)))
+    closed = jax.make_jaxpr(f)(*args)
+    donated = jaxpr_liveness(closed, donated=(0,))
+    held = jaxpr_liveness(closed, donated=())
+    assert donated.donation_savings_bytes > 0
+    assert donated.peak_live_bytes < held.peak_live_bytes
+    assert held.donation_savings_bytes == 0
+    assert donated.largest_buffer_bytes == 256 * 256 * 4
+    # the walk sees through the single pjit eqn jax.jit wraps around
+    closed_jit = jax.make_jaxpr(jax.jit(f))(*args)
+    assert jaxpr_liveness(closed_jit, donated=(0,)).peak_live_bytes \
+        == donated.peak_live_bytes
+
+
+def test_memory_budget_caps_enforced():
+    """max_temp_bytes / max_peak_live_bytes / max_output_bytes trip on
+    a known-fat program and pass with honest headroom."""
+    def fat(a):
+        return jnp.dot(a, a)
+
+    a = jnp.ones((64, 64))
+    with pytest.raises(analysis.BudgetViolation) as ei:
+        analysis.check_budget(
+            fat, analysis.Budget(name="toy-mem", max_temp_bytes=0,
+                                 max_peak_live_bytes=1,
+                                 max_output_bytes=1), a)
+    msg = str(ei.value)
+    assert "peak live bytes" in msg and "output bytes" in msg
+    report = analysis.check_budget(
+        fat, analysis.Budget(max_peak_live_bytes=10 * 64 * 64 * 4), a)
+    assert report.memory.peak_live_bytes >= 2 * 64 * 64 * 4
+    assert report.memory.compiler is not None  # CPU backend reports
+
+
+# -------------------------------------------------------------- sharding
+
+def test_sharding_attr_classification():
+    from paddle_tpu.analysis.sharding import _classify
+
+    assert _classify("") and _classify(None)
+    assert _classify("{replicated}")
+    assert _classify("{maximal device=0}")
+    assert _classify("{devices=[1,1,8]<=[8] last_tile_dim_replicate}")
+    assert not _classify("{devices=[2,4]<=[8]}")
+    assert not _classify("{devices=[2,1,4]<=[8] last_tile_dim_replicate}")
+
+
+def test_sharding_pass_flags_replicated_param():
+    """Known-bad: a large param left replicated over a real mesh while
+    the mesh is in play; max_replicated_param_bytes catches it, and the
+    sharded variant passes the same budget."""
+    mesh = _mesh((8,), ("dp",))
+
+    class _Declared:
+        """jitted target + n_donatable (the param is arg 0)."""
+
+        def __init__(self, jitted):
+            self._jitted = jitted
+            self.n_donatable = 1
+            self.__name__ = "declared_step"
+
+        def lower(self, *args):
+            return self._jitted.lower(*args)
+
+    def step(p, x):
+        return p, (x @ p).sum()
+
+    p_rep = jax.device_put(jnp.zeros((128, 128)),
+                           NamedSharding(mesh, P()))
+    p_shard = jax.device_put(jnp.zeros((128, 128)),
+                             NamedSharding(mesh, P("dp", None)))
+    x = jax.device_put(jnp.ones((8, 128)), NamedSharding(mesh, P("dp")))
+    budget = analysis.Budget(name="no-fat-replicas",
+                             max_replicated_param_bytes=1024,
+                             min_sharded_params=1)
+    target = _Declared(jax.jit(step, donate_argnums=(0,)))
+    with pytest.raises(analysis.BudgetViolation) as ei:
+        analysis.check_budget(target, budget, p_rep, x)
+    assert "replicated donatable leaves" in str(ei.value)
+    report = analysis.check_budget(target, budget, p_shard, x)
+    assert report.sharding.sharded_param_count == 1
+
+
+# ----------------------------------------------------------- fingerprint
+
+def test_fingerprint_mutation_produces_readable_diff():
+    """Acceptance: dropping donate_argnums in a test-local copy of a
+    step drifts the fingerprint with a field-level, human-readable
+    diff."""
+    def update(p, g):
+        return p - 0.1 * g
+
+    p, g = jnp.zeros((64, 64)), jnp.ones((64, 64))
+    golden_report = analysis.audit(
+        jax.jit(update, donate_argnums=(0,)), p, g)
+    golden = analysis.fingerprint_report(golden_report, name="toy")
+    mutated_report = analysis.audit(jax.jit(update), p, g)  # donation lost
+    mutated = analysis.fingerprint_report(mutated_report, name="toy")
+    diff = analysis.compare_fingerprint(golden, mutated)
+    assert diff, "dropped donation must drift the fingerprint"
+    text = "\n".join(diff)
+    assert "donation.donated: golden 1 != current 0 (-1)" in text
+    # identical audits do NOT drift
+    assert analysis.compare_fingerprint(golden, golden) == []
+
+
+def test_fingerprint_golden_roundtrip(tmp_path):
+    report = analysis.audit(lambda a: a * 2, jnp.ones((64,)))
+    fp = analysis.fingerprint_report(report, name="roundtrip")
+    analysis.save_golden(fp, "roundtrip", goldens_dir=str(tmp_path))
+    assert analysis.load_golden("roundtrip",
+                                goldens_dir=str(tmp_path)) == fp
+    assert analysis.check_recipe_fingerprint(
+        "roundtrip", report, goldens_dir=str(tmp_path)) == fp
+    with pytest.raises(analysis.FingerprintMismatch, match="no golden"):
+        analysis.check_recipe_fingerprint(
+            "never_saved", report, goldens_dir=str(tmp_path))
+
+
+# ------------------------------------------------- CLI (serving recipes)
+
+def test_cli_check_and_fingerprint_serving_recipe(capsys):
+    """`python -m paddle_tpu.analysis --recipe serving_decode_step
+    --check --fingerprint` end-to-end: budget enforced and golden
+    compared in one invocation, exit 0, readable output."""
+    from paddle_tpu.analysis.__main__ import main
+
+    rc = main(["--recipe", "serving_decode_step", "--check",
+               "--fingerprint"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "budget [serving decode quantum" in out and "OK" in out
+    assert "fingerprint: OK" in out
+    assert "memory (compiler):" in out and "sharding:" in out
+
+
+def test_cli_failure_paths_print_readable_diff(tmp_path, capsys,
+                                               monkeypatch):
+    """Injected violation + doctored golden: the CLI exits 1 and prints
+    BOTH the budget violation and the per-field fingerprint diff."""
+    from paddle_tpu.analysis import fingerprint as fpm
+    from paddle_tpu.analysis import recipes
+    from paddle_tpu.analysis.__main__ import main
+
+    orig = recipes.RECIPES["serving_decode_step"]
+
+    def tightened():
+        recipe = orig()
+        recipe.budget.max_temp_bytes = 1  # impossible: injected violation
+        return recipe
+
+    monkeypatch.setitem(recipes.RECIPES, "serving_decode_step",
+                        tightened)
+    golden = fpm.load_golden("serving_decode_step")
+    assert golden is not None, "checked-in golden missing"
+    golden["involuntary_remat"] = 7  # doctored: force a drift
+    fpm.save_golden(golden, "serving_decode_step",
+                    goldens_dir=str(tmp_path))
+
+    rc = main(["--recipe", "serving_decode_step", "--check",
+               "--fingerprint", "--goldens-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "VIOLATED" in out
+    assert "compiled temp bytes" in out  # the injected budget breach
+    assert "fingerprint: drift" in out
+    assert "involuntary_remat: golden 7 != current 0 (-7)" in out

@@ -1,11 +1,12 @@
 """paddle_tpu.analysis.lint — tracer-hazard AST linter.
 
 Rule-level tests run the linter over synthetic known-bad/known-clean
-sources; the REPO GATE runs it over the installed ``paddle_tpu/`` tree
-with the checked-in allowlist, so any new host sync, traced-value
-branch, np.-on-tensor, or mutable default introduced by a future PR
-fails tier-1 — and stale allowlist entries fail it too, so the list
-cannot rot."""
+sources; the REPO GATE runs it over the ``paddle_tpu/`` tree AND the
+``scripts/`` bench drivers with the checked-in allowlist, so any new
+host sync, traced-value branch, np.-on-tensor, or mutable default
+introduced by a future PR fails tier-1 — and stale allowlist entries
+fail it too (CLI default since the fingerprint PR), so the list can
+only shrink."""
 import os
 import subprocess
 import sys
@@ -151,12 +152,14 @@ def test_allowlist_requires_justification(tmp_path):
 # ------------------------------------------------------------ repo gate
 
 def test_repo_source_is_tracer_hazard_free():
-    """Tier-1 gate: `paddle_tpu/` must lint clean modulo the checked-in
-    allowlist, and the allowlist must carry no stale entries."""
+    """Tier-1 gate: `paddle_tpu/` AND `scripts/` must lint clean
+    modulo the checked-in allowlist, and the allowlist must carry no
+    stale entries."""
     allow = (load_allowlist(DEFAULT_ALLOWLIST)
              if os.path.exists(DEFAULT_ALLOWLIST) else {})
     violations, unused = lint_paths(
-        [os.path.join(REPO, "paddle_tpu")], allow, root=REPO)
+        [os.path.join(REPO, "paddle_tpu"),
+         os.path.join(REPO, "scripts")], allow, root=REPO)
     assert not violations, (
         "new tracer hazards in framework source (fix them or add a "
         "JUSTIFIED allowlist entry):\n  "
@@ -167,12 +170,33 @@ def test_repo_source_is_tracer_hazard_free():
 @pytest.mark.parametrize("extra", [[], ["--strict-allowlist"]])
 def test_lint_cli_exits_zero_on_repo(extra):
     """The acceptance-criteria contract:
-    `python -m paddle_tpu.analysis.lint paddle_tpu/` exits 0."""
+    `python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/`
+    exits 0."""
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.analysis.lint",
-         "paddle_tpu/"] + extra,
+         "paddle_tpu/", "scripts/"] + extra,
         cwd=REPO, capture_output=True, text=True, timeout=240,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "tracer hazard" in proc.stderr
+
+
+def test_lint_cli_fails_on_stale_allowlist_by_default(tmp_path):
+    """A stale entry (the allowlisted hazard no longer exists) fails
+    the CLI unless --allow-stale: the allowlist can only shrink."""
+    src = tmp_path / "clean.py"
+    src.write_text(CLEAN_SOURCE)
+    allow = tmp_path / "allow.txt"
+    allow.write_text("clean.py:H101:gone  # was fixed long ago\n")
+    base = [sys.executable, "-m", "paddle_tpu.analysis.lint",
+            str(src), "--allowlist", str(allow)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(base, cwd=REPO, capture_output=True,
+                          text=True, timeout=240, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale allowlist" in proc.stderr
+    proc = subprocess.run(base + ["--allow-stale"], cwd=REPO,
+                          capture_output=True, text=True, timeout=240,
+                          env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -378,7 +378,10 @@ def test_serving_decode_step_budget():
     """The machine-checked single-dispatch invariant (ISSUE 2
     acceptance): the EXACT quantum the engine dispatches has zero
     involuntary remat, zero host callbacks/transfers, no collectives,
-    bf16 stays bf16, and every KV pool leaf is donated."""
+    bf16 stays bf16, every KV pool leaf is donated, and temp/peak-live
+    memory stays inside the budget — then the full fingerprint must
+    match the checked-in golden (the ISSUE 4 drift gate; same audited
+    report, no extra compile)."""
     from paddle_tpu import analysis
 
     report = analysis.run_recipe("serving_decode_step")
@@ -386,6 +389,8 @@ def test_serving_decode_step_budget():
     assert report.host_sync is not None and report.host_sync.count == 0
     assert report.total_collectives == 0
     assert report.donation.undonated() == []
+    assert report.memory.temp_bytes is not None
+    analysis.check_recipe_fingerprint("serving_decode_step", report)
 
 
 def test_speculative_verify_step_budget():
@@ -402,3 +407,7 @@ def test_speculative_verify_step_budget():
     assert report.total_collectives == 0
     assert report.donation.undonated() == []
     assert report.donation.n_donatable == 6  # 2*2 target + 2*1 draft
+    # the liveness walk must see the donation actually saving HBM:
+    # both pools roll in-place rather than double-buffering
+    assert report.memory.liveness.donation_savings_bytes > 0
+    analysis.check_recipe_fingerprint("speculative_verify_step", report)

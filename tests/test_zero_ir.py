@@ -189,7 +189,11 @@ def test_no_involuntary_remat_with_tp_and_zero(fused_lce):
 def test_fused_lce_recipe_budget_matches_registered():
     """The registered analysis recipe IS this test's invariant: keep the
     two wired together so the CLI/bench budget and the tier-1 assertion
-    cannot diverge."""
+    cannot diverge. Since the fingerprint PR the recipe also pins its
+    memory/sharding caps and its golden (checked from the same report;
+    tests/goldens/llama_tp_zero_fused_lce.json is the TP2 x ZeRO
+    fingerprint)."""
+    from paddle_tpu import analysis
     from paddle_tpu.analysis import recipes
 
     recipe = recipes.build("llama_tp_zero_fused_lce")
@@ -197,6 +201,11 @@ def test_fused_lce_recipe_budget_matches_registered():
         assert recipe.budget.max_remat == 0
         assert recipe.budget.require_reduce_scatter
         assert recipe.budget.require_donated
-        recipe.check()
+        assert recipe.budget.max_peak_live_bytes is not None
+        assert recipe.budget.max_replicated_param_bytes is not None
+        assert recipe.budget.min_sharded_params is not None
+        report = recipe.check()
+        analysis.check_recipe_fingerprint(
+            "llama_tp_zero_fused_lce", report)
     finally:
         recipe.close()
