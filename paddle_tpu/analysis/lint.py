@@ -28,6 +28,13 @@ the scope's PARAMETERS are the traced values. Rules:
 - **H105 mutable default**: a ``[]`` / ``{}`` / ``set()`` default
   argument anywhere (not jit-specific, but the classic shared-state
   footgun) .
+- **H106 wall-clock in jit scope**: ``time.time()`` /
+  ``time.perf_counter()`` / ``time.monotonic()`` (and their ``_ns``
+  forms, incl. bare from-imports) inside a jit scope — the timestamp
+  constant-folds into the trace at compile time, so the "measurement"
+  silently reports the tracing wall clock forever after.
+  Instrumentation belongs at quantum/step boundaries on the host
+  (``paddle_tpu.obs``), never inside the compiled program.
 
 Known limits (by design, to stay fast and false-positive-light): the
 scope detection is lexical per module — a module-level helper that is
@@ -57,7 +64,18 @@ RULES = {
     "H103": "np.* call on a traced value inside a jit scope",
     "H104": "Python if/while on a traced value inside a jit scope",
     "H105": "mutable default argument",
+    "H106": "wall-clock read (time.time/perf_counter/monotonic) inside "
+            "a jit scope — constant-folds into the trace",
 }
+
+# wall-clock reads that constant-fold under tracing: the time-module
+# attribute forms plus their bare from-import names
+_WALLCLOCK_SUFFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.time_ns", "time.perf_counter_ns", "time.monotonic_ns",
+)
+_WALLCLOCK_BARE = ("perf_counter", "monotonic", "perf_counter_ns",
+                   "monotonic_ns", "time_ns")
 
 # a call to any of these makes its function-valued args jit scopes;
 # matched on the DOTTED SUFFIX of the callee (jax.lax.scan == lax.scan)
@@ -407,6 +425,13 @@ class _TaintChecker:
                         f"{ast.unparse(node.func.value)[:40]}")
                 continue
             callee = _dotted(node.func)
+            # H106: wall-clock read — hazardous REGARDLESS of taint
+            # (the clock needs no traced operand to constant-fold)
+            if callee is not None and (
+                    _suffix_match(callee, _WALLCLOCK_SUFFIXES)
+                    or callee in _WALLCLOCK_BARE):
+                self._flag("H106", node, f"{callee}()")
+                continue
             # H102: float/int/bool on tainted
             if callee in ("float", "int", "bool") and node.args \
                     and self.tainted(node.args[0]):

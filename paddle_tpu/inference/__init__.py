@@ -150,7 +150,11 @@ def create_serving_engine(model, **kwargs):
     quantum). Keyword args forward to the engine — num_slots,
     block_size, decode_quantum, decode_strategy, eos_token_id, ...;
     pass ``spec_draft=<draft LM>`` (and ``spec_gamma``) to switch the
-    quantum to the one-dispatch SPECULATIVE drafter/verifier round.
+    quantum to the one-dispatch SPECULATIVE drafter/verifier round,
+    and ``trace=True`` (or ``obs=<ServingObs>``) for the runtime
+    observability layer — metrics registry + Chrome-trace request
+    spans via :mod:`paddle_tpu.obs`, all recorded at host scheduler
+    boundaries (the jitted quantum's fingerprint is unchanged).
     See :mod:`paddle_tpu.serving`."""
     from ..serving import ServingEngine
 

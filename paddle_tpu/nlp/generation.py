@@ -474,7 +474,7 @@ def generate(model, input_ids, max_new_tokens=32,
 def speculative_generate(target, draft, input_ids, max_new_tokens=32,
                          gamma=4, decode_strategy="greedy", top_k=0,
                          top_p=1.0, temperature=1.0, seed=0,
-                         eos_token_id=None, block_size=32):
+                         eos_token_id=None, block_size=32, obs=None):
     """ON-DEVICE speculative decoding through the serving engine
     (reference: the speculative-decoding serving mode of the reference
     NLP stack — unverified, SURVEY.md §0). Every batch row rides a
@@ -491,7 +491,13 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=32,
 
     Returns ``(tokens, acceptance_rate)``: (B, S_in+max_new) ids (rows
     finishing early at ``eos_token_id`` pad the tail with it) and the
-    draft-proposal acceptance rate across the run."""
+    draft-proposal acceptance rate across the run.
+
+    ``obs`` forwards to the engine — pass a
+    :class:`paddle_tpu.obs.ServingObs` to collect this call's TTFT /
+    latency / acceptance metrics (and trace spans, if its tracer is
+    set) into a registry you scrape; all recording happens at host
+    round boundaries, never in the jitted dispatch."""
     import numpy as np
     import paddle_tpu as paddle
     from ..serving import ServingEngine
@@ -507,7 +513,7 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=32,
         target, spec_draft=draft, spec_gamma=gamma, num_slots=b,
         block_size=block_size, max_context=s_in + max_new_tokens,
         decode_strategy=strategy, top_k=top_k, top_p=top_p,
-        temperature=temperature, eos_token_id=eos_token_id)
+        temperature=temperature, eos_token_id=eos_token_id, obs=obs)
     reqs = [engine.submit(rows[i], max_new_tokens=max_new_tokens,
                           seed=seed + i) for i in range(b)]
     engine.run()

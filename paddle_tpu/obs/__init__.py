@@ -1,0 +1,51 @@
+"""paddle_tpu.obs — runtime observability: metrics registry, request
+tracing, and hot-path-safe serving/training telemetry.
+
+The static tier (:mod:`paddle_tpu.analysis`) can prove a compiled
+graph's SHAPE (collectives, remat, donation, fingerprints); this
+package is the RUNTIME half: what is TTFT / tokens-per-second /
+spec-decode acceptance doing over time, per request and per step.
+
+Layout:
+
+- :mod:`.registry` — :class:`MetricsRegistry` with counters, gauges
+  and fixed-bucket histograms; Prometheus text exposition and a
+  stable-sorted JSON snapshot.
+- :mod:`.trace` — :class:`TraceRecorder`: bounded Chrome trace-event
+  buffer (``X``/``i``/``C``/``M`` phases), exported as Perfetto-
+  loadable JSON; ``validate_chrome_trace`` / ``load_chrome_trace``
+  round-trip the schema.
+- :mod:`.serving` — :class:`ServingObs`: the engine's boundary hooks
+  (ttft/e2e/inter-token histograms, windowed tok/s, acceptance-rate
+  series, pool gauges, per-request spans) + the legacy
+  ``engine.stats`` compatibility view.
+- :mod:`.train` — :class:`InstrumentedTrainStep`: step time, tokens/s
+  and MFU (via :mod:`paddle_tpu.profiler.mfu`) into the same registry.
+
+The hard invariant, enforced by the golden-fingerprint gate: every
+hook runs on the host at a quantum/step boundary — the jitted decode
+quantum, speculative round, and train step keep ``max_host_callbacks=
+0`` and byte-identical fingerprints with observability enabled.
+
+CLI::
+
+    python -m paddle_tpu.obs snapshot --demo --format prom
+    python -m paddle_tpu.obs export --demo --out /tmp/trace.json
+    python -m paddle_tpu.obs check   # instrumented fingerprint gate
+"""
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, LATENCY_BUCKETS, MetricsRegistry,
+    prometheus_from_snapshot,
+)
+from .trace import (  # noqa: F401
+    TraceRecorder, load_chrome_trace, validate_chrome_trace,
+)
+from .serving import ServingObs  # noqa: F401
+from .train import InstrumentedTrainStep  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "LATENCY_BUCKETS",
+    "MetricsRegistry", "prometheus_from_snapshot",
+    "TraceRecorder", "load_chrome_trace", "validate_chrome_trace",
+    "ServingObs", "InstrumentedTrainStep",
+]

@@ -1,0 +1,195 @@
+"""Observability CLI::
+
+    python -m paddle_tpu.obs snapshot --in metrics.json [--format prom]
+    python -m paddle_tpu.obs snapshot --demo [--format prom|json]
+    python -m paddle_tpu.obs export --demo --out trace.json \
+        [--metrics-out metrics.json] [--spec]
+    python -m paddle_tpu.obs export --in trace.json      # validate
+    python -m paddle_tpu.obs check                       # CI gate
+
+``snapshot`` renders a metrics snapshot (live from the ``--demo``
+engine run, or re-rendered offline from a saved ``--in`` JSON dump) as
+Prometheus text or stable-sorted JSON. ``export`` writes/validates the
+Chrome trace-event JSON (open in Perfetto / chrome://tracing); with
+``--demo`` it drives a tiny CPU serving engine (``--spec`` switches it
+to the speculative arm) so the artifact carries real request spans.
+``check`` is the instrumentation-can't-change-the-graph gate used by
+``scripts/check_graphs.sh``: it builds the serving + speculative
+analysis recipes — whose engines run with FULL observability (registry
++ tracer) — re-checks their budgets, compares the golden fingerprints,
+and asserts the instrumentation actually recorded (metrics counted,
+trace validates). Exit non-zero on drift.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _demo_engine(spec=False, trace=True):
+    """A tiny CPU serving run with full instrumentation: a handful of
+    ragged requests through prefill/decode (+ the speculative arm),
+    enough to populate every serving metric and trace track."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    kw = {}
+    if spec:
+        paddle.seed(7)
+        kw = dict(
+            spec_draft=LlamaForCausalLM(LlamaConfig.tiny(
+                tensor_parallel=False, num_hidden_layers=1)),
+            spec_gamma=2)
+    engine = ServingEngine(model, num_slots=3, block_size=4,
+                           prefill_chunk=4, decode_quantum=3,
+                           trace=trace, **kw)
+    rng = np.random.RandomState(0)
+    for n, mn in ((5, 6), (9, 4), (3, 8), (12, 5)):
+        engine.submit(rng.randint(1, cfg.vocab_size, n)
+                      .astype(np.int32), max_new_tokens=mn)
+    engine.run()
+    return engine
+
+
+def _cmd_snapshot(args):
+    from .registry import prometheus_from_snapshot
+
+    if args.demo:
+        snap = _demo_engine(spec=args.spec,
+                            trace=False).obs.registry.snapshot()
+    elif args.infile:
+        with open(args.infile) as f:
+            snap = json.load(f)
+    else:
+        print("snapshot: need --demo or --in FILE", file=sys.stderr)
+        return 2
+    text = (prometheus_from_snapshot(snap) if args.format == "prom"
+            else json.dumps(snap, indent=2, sort_keys=True) + "\n")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def _cmd_export(args):
+    from .trace import load_chrome_trace
+
+    if args.demo:
+        if not args.out:
+            print("export --demo: need --out FILE", file=sys.stderr)
+            return 2
+        engine = _demo_engine(spec=args.spec, trace=True)
+        engine.obs.tracer.save(args.out)
+        n = len(engine.obs.tracer.events)
+        print(f"wrote {args.out}: {n} trace events "
+              f"({engine.obs.tracer.dropped} dropped)", file=sys.stderr)
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                f.write(engine.obs.registry.snapshot_json(indent=2))
+            print(f"wrote {args.metrics_out}", file=sys.stderr)
+        return 0
+    if args.infile:
+        obj = load_chrome_trace(args.infile)
+        print(f"{args.infile}: valid chrome trace, "
+              f"{len(obj['traceEvents'])} events", file=sys.stderr)
+        return 0
+    print("export: need --demo or --in FILE", file=sys.stderr)
+    return 2
+
+
+_CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step")
+
+
+def _cmd_check(args):
+    """Instrumented-fingerprint gate: the serving recipes construct
+    their engines with full observability ON (analysis/recipes.py);
+    budgets + goldens must hold anyway, and the instrumentation must
+    have actually observed the prefill step it rode along with."""
+    from paddle_tpu import analysis
+    from .trace import validate_chrome_trace
+
+    failed = False
+    for name in (args.recipe or _CHECK_RECIPES):
+        recipe = analysis.build_recipe(name)
+        try:
+            report = recipe.check()  # budget (incl. 0 host callbacks)
+            analysis.check_recipe_fingerprint(name, report)
+            engine = getattr(recipe, "engine", None)
+            if engine is None:
+                raise AssertionError(
+                    f"{name}: recipe carries no engine handle")
+            if engine.obs.tracer is None:
+                raise AssertionError(
+                    f"{name}: engine built without tracing — the gate "
+                    f"must audit the INSTRUMENTED engine")
+            if engine.stats["steps"] < 1 \
+                    or engine.obs.registry.get(
+                        "serving_requests_admitted_total").value() < 1:
+                raise AssertionError(
+                    f"{name}: instrumentation recorded nothing")
+            validate_chrome_trace(engine.obs.tracer.chrome_trace())
+            print(f"{name}: budget ok, fingerprint ok, "
+                  f"{len(engine.obs.tracer.events)} trace events, "
+                  f"{report.host_sync.count} host callbacks")
+        except (analysis.BudgetViolation, analysis.FingerprintMismatch,
+                AssertionError, ValueError) as e:
+            failed = True
+            print(f"{name}: FAIL — {e}", file=sys.stderr)
+        finally:
+            recipe.close()
+    if failed:
+        return 1
+    print("obs check: instrumentation-enabled fingerprints unchanged")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.obs",
+        description="runtime observability CLI (see module docstring)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("snapshot", help="render a metrics snapshot")
+    p.add_argument("--in", dest="infile", default=None,
+                   help="saved snapshot JSON to re-render")
+    p.add_argument("--demo", action="store_true",
+                   help="drive a tiny CPU serving engine instead")
+    p.add_argument("--spec", action="store_true",
+                   help="demo uses the speculative arm")
+    p.add_argument("--format", choices=("prom", "json"),
+                   default="prom")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=_cmd_snapshot)
+
+    p = sub.add_parser("export",
+                       help="write/validate a Chrome trace JSON")
+    p.add_argument("--in", dest="infile", default=None,
+                   help="existing trace to validate")
+    p.add_argument("--demo", action="store_true")
+    p.add_argument("--spec", action="store_true")
+    p.add_argument("--out", default=None)
+    p.add_argument("--metrics-out", default=None,
+                   help="also dump the demo registry snapshot here")
+    p.set_defaults(fn=_cmd_export)
+
+    p = sub.add_parser("check",
+                       help="instrumented-fingerprint CI gate")
+    p.add_argument("--recipe", action="append", default=None,
+                   choices=_CHECK_RECIPES)
+    p.set_defaults(fn=_cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""Serving-engine instrumentation: every hook here runs ON THE HOST at
+a scheduler boundary (submit/admit, mixed prefill step, decode-quantum
+or spec-round dispatch, retire) — never inside the jitted quantum, so
+the compiled program the ``serving_decode_step`` /
+``speculative_verify_step`` budgets pin is byte-identical with
+observability enabled (the golden-fingerprint gate proves it).
+
+:class:`ServingObs` owns a :class:`~paddle_tpu.obs.registry.
+MetricsRegistry` (always-on: counters/gauges/histograms are dict ops)
+and an optional :class:`~paddle_tpu.obs.trace.TraceRecorder` (per-
+request lifecycle spans on per-slot tracks, quantum spans + counter
+tracks on the engine track — Perfetto-loadable). The engine's legacy
+``stats`` dict survives as :class:`_LegacyStatsView`, a thin
+MutableMapping over the same registry counters, so pre-observability
+callers (benches, tests) read/reset the exact values the registry
+exports.
+
+Exported serving metrics (all host-boundary):
+
+- counters: ``serving_requests_{submitted,admitted,finished}_total``,
+  ``serving_tokens_emitted_total`` (one bump per token actually
+  appended to a request — the stream-match invariant the obs tests
+  assert), plus the legacy ``serving_*_total`` counters behind
+  ``engine.stats``.
+- histograms: ``serving_queue_wait_seconds``, ``serving_ttft_seconds``
+  (observed exactly once per request, at the prefill-completion step
+  that emits its first token), ``serving_e2e_latency_seconds``,
+  ``serving_inter_token_seconds`` (per-request mean at retirement),
+  ``serving_quantum_seconds{kind=decode|spec_round|mixed}``.
+- gauges: ``serving_tokens_per_second_window`` (trailing-window
+  throughput), ``serving_spec_acceptance_rate`` (per-round),
+  ``serving_slots_occupied``, ``serving_pool_{blocks_in_use,
+  free_blocks,utilization}{pool=target|draft}``.
+- time series (host ring buffers, not prometheus):
+  :meth:`timeseries` — ``tokens_per_s`` and ``spec_acceptance_rate``
+  points for offline plots.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from collections.abc import MutableMapping
+
+from .registry import LATENCY_BUCKETS, MetricsRegistry
+from .trace import TraceRecorder
+
+__all__ = ["ServingObs"]
+
+# legacy ServingEngine.stats key -> registry counter name, in the
+# historical dict order (engine_stats()'s shape is part of the API)
+_LEGACY_KEYS = {
+    "steps": "serving_steps_total",
+    "mixed_steps": "serving_mixed_steps_total",
+    "decode_quanta": "serving_decode_quanta_total",
+    "quantum_tokens": "serving_quantum_tokens_total",
+    "prefill_tokens": "serving_prefill_tokens_total",
+    "generated_tokens": "serving_generated_tokens_total",
+    "occupancy_sum": "serving_occupancy_sum",
+    "spec_rounds": "serving_spec_rounds_total",
+    "spec_proposed": "serving_spec_proposed_total",
+    "spec_accepted": "serving_spec_accepted_total",
+}
+_FLOAT_KEYS = ("occupancy_sum",)
+
+
+class _LegacyStatsView(MutableMapping):
+    """``engine.stats`` compatibility: same keys, same int/float types,
+    same iteration order — but every read/write goes through the
+    registry counters, so there is exactly ONE source of truth."""
+
+    def __init__(self, counters):
+        self._counters = counters  # legacy key -> Counter
+
+    def __getitem__(self, key):
+        v = self._counters[key].value()
+        return v if key in _FLOAT_KEYS else int(v)
+
+    def __setitem__(self, key, value):
+        self._counters[key]._set(value)
+
+    def __delitem__(self, key):
+        raise TypeError("engine.stats has a fixed key set")
+
+    def __iter__(self):
+        return iter(self._counters)
+
+    def __len__(self):
+        return len(self._counters)
+
+    def __repr__(self):
+        return repr(dict(self))
+
+
+class ServingObs:
+    """Metrics + tracing sink for one :class:`ServingEngine`.
+
+    Args:
+        registry: share a registry across engines (default: fresh).
+        trace: record Chrome trace events (bounded buffer; off by
+            default — the metrics registry alone is always on).
+        tracer: bring your own :class:`TraceRecorder` (wins over
+            ``trace``).
+        enabled: ``False`` short-circuits every rich hook (histograms,
+            gauges, tracer, time series) — the ``obs="off"`` arm of the
+            ``serving_obs_overhead`` bench; the legacy stats counters
+            keep working either way.
+        window_s: trailing window for the tokens/s gauge.
+    """
+
+    def __init__(self, registry=None, trace=False, tracer=None,
+                 enabled=True, window_s=1.0, series_maxlen=4096):
+        self.enabled = bool(enabled)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = tracer if tracer is not None \
+            else (TraceRecorder() if trace else None)
+        self.window_s = float(window_s)
+        r = self.registry
+        self._legacy = {
+            key: r.counter(name, f"legacy engine.stats[{key!r}]")
+            for key, name in _LEGACY_KEYS.items()
+        }
+        self._c_submitted = r.counter(
+            "serving_requests_submitted_total", "requests queued")
+        self._c_admitted = r.counter(
+            "serving_requests_admitted_total", "requests given a slot")
+        self._c_finished = r.counter(
+            "serving_requests_finished_total", "requests retired")
+        self._c_tokens = r.counter(
+            "serving_tokens_emitted_total",
+            "tokens appended to request streams")
+        self._h_queue = r.histogram(
+            "serving_queue_wait_seconds", "submit -> admit",
+            buckets=LATENCY_BUCKETS)
+        self._h_ttft = r.histogram(
+            "serving_ttft_seconds",
+            "submit -> first generated token (once per request)",
+            buckets=LATENCY_BUCKETS)
+        self._h_e2e = r.histogram(
+            "serving_e2e_latency_seconds", "submit -> retirement",
+            buckets=LATENCY_BUCKETS)
+        self._h_itl = r.histogram(
+            "serving_inter_token_seconds",
+            "per-request mean inter-token latency at retirement",
+            buckets=LATENCY_BUCKETS)
+        self._h_quantum = r.histogram(
+            "serving_quantum_seconds",
+            "one dispatch: mixed step / decode quantum / spec round",
+            buckets=LATENCY_BUCKETS)
+        self._g_rate = r.gauge(
+            "serving_tokens_per_second_window",
+            "generated tok/s over the trailing window")
+        self._g_accept = r.gauge(
+            "serving_spec_acceptance_rate",
+            "per-round accepted/proposed")
+        self._g_slots = r.gauge(
+            "serving_slots_occupied", "live slots this step")
+        self._g_blocks = r.gauge(
+            "serving_pool_blocks_in_use", "KV pool blocks allocated")
+        self._g_free = r.gauge(
+            "serving_pool_free_blocks", "KV pool free-list length")
+        self._g_util = r.gauge(
+            "serving_pool_utilization",
+            "live tokens / allocated token capacity")
+        self._window = deque()
+        self._cum_tokens = 0
+        self._series = {
+            "tokens_per_s": deque(maxlen=series_maxlen),
+            "spec_acceptance_rate": deque(maxlen=series_maxlen),
+        }
+
+    # the engine's single clock (the old code had six scattered
+    # ``now = time.perf_counter()`` blocks)
+    @staticmethod
+    def now():
+        return time.perf_counter()
+
+    def legacy_stats_view(self):
+        return _LegacyStatsView(self._legacy)
+
+    def timeseries(self):
+        """{"tokens_per_s": [(t, v), ...], "spec_acceptance_rate":
+        [...]} — host ring buffers for offline plotting."""
+        return {k: list(v) for k, v in self._series.items()}
+
+    # -- request lifecycle hooks -------------------------------------------
+    def on_submit(self, req):
+        if not self.enabled:
+            return
+        self._c_submitted.inc()
+        if self.tracer is not None:
+            self.tracer.thread_name(0, "engine")
+            self.tracer.instant("submit", req.arrival_time, tid=0,
+                                args={"req": str(req.req_id)})
+
+    def on_admit(self, req, now):
+        if not self.enabled:
+            return
+        self._c_admitted.inc()
+        self._h_queue.observe(now - req.arrival_time)
+        if self.tracer is not None:
+            tid = req.slot + 1
+            self.tracer.thread_name(tid, f"slot{req.slot}")
+            self.tracer.instant("admit", now, tid=tid,
+                                args={"req": str(req.req_id)})
+
+    def on_first_token(self, req, now):
+        """TTFT — the caller stamps ``first_token_time`` exactly once
+        (at the prefill-completion step), so this observes once per
+        request by construction."""
+        if not self.enabled:
+            return
+        self._h_ttft.observe(now - req.arrival_time)
+        if self.tracer is not None:
+            self.tracer.instant("first_token", now, tid=req.slot + 1,
+                                args={"req": str(req.req_id)})
+
+    def on_token(self, req):
+        """One token actually appended to a request's stream."""
+        if self.enabled:
+            self._c_tokens.inc()
+
+    def on_retire(self, req, now):
+        if not self.enabled:
+            return
+        self._c_finished.inc()
+        self._h_e2e.observe(now - req.arrival_time)
+        n = len(req.tokens)
+        if req.first_token_time is not None and n >= 2:
+            self._h_itl.observe(
+                (req.finish_time - req.first_token_time) / (n - 1))
+        if self.tracer is not None and req.slot is not None:
+            self.tracer.complete(
+                f"req {req.req_id}", req.admit_time or now, now,
+                tid=req.slot + 1,
+                args={"tokens": n, "reason": req.finish_reason,
+                      "prompt_len": req.prompt_len})
+
+    # -- step / dispatch hooks ---------------------------------------------
+    def on_step(self, now, live, num_slots, pool, d_pool=None):
+        """Per-scheduler-iteration gauges (slot occupancy + pool
+        health); also feeds the trace's counter tracks."""
+        if not self.enabled:
+            return
+        self._g_slots.set(live)
+        pools = [("target", pool)]
+        if d_pool is not None:
+            pools.append(("draft", d_pool))
+        for label, p in pools:
+            st = p.fragmentation_stats()
+            self._g_blocks.set(st["blocks_in_use"], pool=label)
+            self._g_free.set(st["free_blocks"], pool=label)
+            self._g_util.set(st["utilization"], pool=label)
+        if self.tracer is not None:
+            self.tracer.counter(
+                "occupancy", now,
+                {"live_slots": live, "free_slots": num_slots - live})
+            self.tracer.counter(
+                "pool_blocks", now,
+                {label: p.blocks_in_use for label, p in pools})
+
+    def on_quantum(self, kind, t0, t1, tokens, rows):
+        """One dispatch boundary: ``kind`` is ``mixed`` (chunked
+        prefill + decode rows through block_mha), ``decode`` (the
+        jitted quantum) or ``spec_round``; ``tokens`` is how many
+        tokens the dispatch appended to request streams."""
+        if not self.enabled:
+            return
+        self._h_quantum.observe(t1 - t0, kind=kind)
+        self._cum_tokens += int(tokens)
+        self._window.append((t1, self._cum_tokens))
+        while len(self._window) > 2 \
+                and t1 - self._window[0][0] > self.window_s:
+            self._window.popleft()
+        t_old, c_old = self._window[0]
+        if t1 > t_old:
+            rate = (self._cum_tokens - c_old) / (t1 - t_old)
+            self._g_rate.set(rate)
+            self._series["tokens_per_s"].append((t1, rate))
+        if self.tracer is not None:
+            self.tracer.complete(kind, t0, t1, tid=0,
+                                 args={"tokens": int(tokens),
+                                       "rows": int(rows)})
+            self.tracer.counter("tokens_per_s", t1,
+                                {"window": self._g_rate.value()})
+
+    def on_spec_round(self, now, proposed, accepted):
+        if not self.enabled or proposed <= 0:
+            return
+        rate = accepted / proposed
+        self._g_accept.set(rate)
+        self._series["spec_acceptance_rate"].append((now, rate))

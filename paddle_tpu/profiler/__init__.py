@@ -121,7 +121,14 @@ class Profiler:
 
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False, log_dir=None):
+                 with_flops=False, log_dir=None, registry=None):
+        # optional paddle_tpu.obs.MetricsRegistry: step() feeds the
+        # `profiler_step_seconds` histogram so profiler windows and the
+        # serving/train telemetry share one scrape surface
+        self._registry = registry
+        self._h_step = (registry.histogram(
+            "profiler_step_seconds", "Profiler.step() intervals")
+            if registry is not None else None)
         if isinstance(scheduler, tuple):
             start, end = scheduler
             self._scheduler = make_scheduler(
@@ -164,6 +171,8 @@ class Profiler:
         now = time.perf_counter()
         if self._last_step_t is not None:
             self._step_times.append(now - self._last_step_t)
+            if self._h_step is not None:
+                self._h_step.observe(now - self._last_step_t)
         self._last_step_t = now
         self._step_no += 1
         self._maybe_transition()

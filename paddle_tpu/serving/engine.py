@@ -37,11 +37,22 @@ pool rides the same scheduler — admission gates on both pools plus the
 verify-write margin, chunked prefill pushes the same mixed batches
 through the draft, and one jitted dispatch per round covers draft-γ
 scan + target verify + in-graph acceptance with BOTH pools donated.
+
+Runtime observability rides the SAME boundaries the host scheduler
+already owns (paddle_tpu/obs): ``engine.obs`` carries the metrics
+registry (TTFT/e2e/inter-token histograms, windowed tok/s, acceptance
+rate, pool gauges — ``engine.stats`` is a thin compatibility view over
+its counters) and, with ``trace=True``, a Chrome trace-event recorder
+(per-slot request spans + quantum spans, Perfetto-loadable). Because
+every hook runs at a quantum/step boundary on the host, the jitted
+programs keep ``max_host_callbacks=0`` and byte-identical golden
+fingerprints with observability enabled — asserted by the
+``serving_decode_step`` / ``speculative_verify_step`` recipes, which
+build THIS engine with full instrumentation on.
 """
 from __future__ import annotations
 
 import math
-import time
 
 import numpy as np
 import jax
@@ -52,6 +63,7 @@ from ..core import autograd
 from ..jit import functional_call
 from ..nlp.generation import _filter_logits
 from ..nlp.paged_cache import PagedKVCachePool
+from ..obs.serving import ServingObs
 from .scheduler import Request, Scheduler, SchedulerConfig
 
 __all__ = ["ServingEngine"]
@@ -296,13 +308,26 @@ class ServingEngine:
             greedy arm emits exactly the target's greedy stream; the
             sampling arm is distribution-exact rejection sampling.
         spec_gamma: proposals per speculative round (default 4).
+        obs: observability sink — ``None`` builds a fresh
+            :class:`~paddle_tpu.obs.serving.ServingObs` (metrics
+            registry always on), ``"off"`` disables the rich hooks
+            (histograms/gauges/tracer; the legacy ``stats`` counters
+            keep working — the overhead-bench baseline), or pass a
+            :class:`ServingObs` to share a registry across engines.
+            Every hook fires at host scheduler boundaries only: the
+            jitted quantum keeps its ``max_host_callbacks=0`` budget
+            and byte-identical golden fingerprint (tier-1 gated).
+        trace: record Chrome trace events (request lifecycle spans,
+            quantum spans, occupancy/pool counter tracks) into
+            ``engine.obs.tracer`` — export with
+            ``engine.obs.tracer.save(path)``, open in Perfetto.
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
                  max_context=None, prefill_chunk=64, decode_quantum=8,
                  decode_strategy="greedy", top_k=0, top_p=1.0,
                  temperature=1.0, eos_token_id=None, spec_draft=None,
-                 spec_gamma=4):
+                 spec_gamma=4, obs=None, trace=False):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -417,11 +442,21 @@ class ServingEngine:
             self._audited = _AuditedStep(
                 self._quantum, n_donatable=2 * cfg.num_hidden_layers)
         self.completed: list = []
-        self.stats = {"steps": 0, "mixed_steps": 0, "decode_quanta": 0,
-                      "quantum_tokens": 0, "prefill_tokens": 0,
-                      "generated_tokens": 0, "occupancy_sum": 0.0,
-                      "spec_rounds": 0, "spec_proposed": 0,
-                      "spec_accepted": 0}
+        # observability: metrics registry (always on unless "off") +
+        # optional tracer; `stats` is the legacy dict READ/WRITE view
+        # over the same registry counters (one source of truth)
+        if obs == "off":
+            self.obs = ServingObs(enabled=False)
+        elif obs is None:
+            self.obs = ServingObs(trace=trace)
+        else:
+            self.obs = obs
+            if trace and self.obs.tracer is None:
+                from ..obs.trace import TraceRecorder
+
+                self.obs.tracer = TraceRecorder()
+        self._now = self.obs.now
+        self.stats = self.obs.legacy_stats_view()
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, req_id=None, seed=0,
@@ -429,7 +464,7 @@ class ServingEngine:
         """Queue one request; returns the :class:`Request` handle."""
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       req_id=req_id, seed=seed,
-                      arrival_time=(time.perf_counter()
+                      arrival_time=(self._now()
                                     if arrival_time is None
                                     else arrival_time))
         total = req.prompt_len + req.max_new_tokens
@@ -437,7 +472,9 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {total} tokens > max_context "
                 f"{self.max_context}")
-        return self.scheduler.submit(req)
+        self.scheduler.submit(req)
+        self.obs.on_submit(req)
+        return req
 
     @property
     def has_work(self):
@@ -451,6 +488,8 @@ class ServingEngine:
         live = self.scheduler.live()
         self.stats["occupancy_sum"] += (
             len(live) / self.config.num_slots)
+        self.obs.on_step(self._now(), len(live), self.config.num_slots,
+                         self.pool, self.d_pool)
         if self.scheduler.prefilling():
             self._mixed_step()
         elif self.scheduler.decoding():
@@ -464,6 +503,7 @@ class ServingEngine:
             for r in requests:
                 if isinstance(r, Request):
                     self.scheduler.submit(r)
+                    self.obs.on_submit(r)
                 elif isinstance(r, dict):
                     self.submit(**r)
                 else:
@@ -501,9 +541,10 @@ class ServingEngine:
 
     # -- admission + prefill ----------------------------------------------
     def _admit(self):
-        now = time.perf_counter()
+        now = self._now()
         for req in self.scheduler.try_admit():
             req.admit_time = now
+            self.obs.on_admit(req, now)
             slot = req.slot
             self._seq_lens[slot] = 0
             self._n_gen[slot] = 0
@@ -576,6 +617,7 @@ class ServingEngine:
         model into the draft pool (token selection stays the target's;
         the draft forward exists only for its KV writes)."""
         model = self.model
+        t0 = self._now()
         self.stats["mixed_steps"] += 1
         chunk = self.config.prefill_chunk
         pre = self.scheduler.prefilling()
@@ -632,7 +674,8 @@ class ServingEngine:
                 logits = model.lm_head(hs)._value        # (R, V)
             nxt = self._select_host(logits,
                                     [rows[i] for i in need])
-        now = time.perf_counter()
+        now = self._now()
+        emitted = 0
         for i, req in enumerate(rows):
             slot = req.slot
             if i < len(pre):
@@ -641,14 +684,26 @@ class ServingEngine:
                 if req.prefill_pos >= req.prompt_len:
                     tok = int(nxt[need.index(i)])
                     req.first_token_time = now
-                    req.record(tok, self.eos_token_id)
+                    self.obs.on_first_token(req, now)
+                    self._emit(req, tok)
+                    emitted += 1
                     self._record_host(slot, req, tok)
             else:
                 tok = int(nxt[need.index(i)])
                 self._seq_lens[slot] += 1  # last_tok entered the cache
-                req.record(tok, self.eos_token_id)
+                self._emit(req, tok)
+                emitted += 1
                 self._record_host(slot, req, tok)
+        self.obs.on_quantum("mixed", t0, now, emitted, len(rows))
         self._retire_finished()
+
+    def _emit(self, req, tok):
+        """Append ONE generated token to a request's stream (retirement
+        rule included) and count it — the obs token counter matches the
+        emitted streams exactly because every append goes through
+        here."""
+        req.record(tok, self.eos_token_id)
+        self.obs.on_token(req)
 
     def _record_host(self, slot, req, tok):
         self._last_tok[slot] = tok
@@ -747,6 +802,7 @@ class ServingEngine:
         per-round token yield composes with the same retirement masks
         as the plain quantum."""
         g = self.spec_gamma
+        t0 = self._now()
         self.stats["spec_rounds"] += 1
         rows = self.scheduler.decoding()
         for req in rows:
@@ -778,15 +834,19 @@ class ServingEngine:
         self.stats["quantum_tokens"] += int(counts.sum())
         self.stats["spec_proposed"] += g * len(rows)
         self.stats["spec_accepted"] += int(acc.sum())
-        now = time.perf_counter()
+        now = self._now()
+        emitted = 0
         for req in rows:
             slot = req.slot
             for k in range(int(counts[slot])):
                 if req.finished:
                     break
-                req.record(int(stream[slot, k]), self.eos_token_id)
+                self._emit(req, int(stream[slot, k]))
+                emitted += 1
             if req.finished:
                 req.finish_time = now
+        self.obs.on_quantum("spec_round", t0, now, emitted, len(rows))
+        self.obs.on_spec_round(now, g * len(rows), int(acc.sum()))
         self._retire_finished()
 
     def _decode_quantum(self):
@@ -795,6 +855,7 @@ class ServingEngine:
         boundary, never inside the compiled loop."""
         if self.spec_draft is not None:
             return self._spec_round_step()
+        t0 = self._now()
         self.stats["decode_quanta"] += 1
         t_steps = self.config.decode_quantum
         # grow each live slot's block table to cover the quantum before
@@ -819,25 +880,30 @@ class ServingEngine:
         self._done = np.asarray(done).copy()
         self.stats["quantum_tokens"] += int(toks.shape[0]) * int(
             toks.shape[1])
-        now = time.perf_counter()
-        for req in self.scheduler.decoding():
+        now = self._now()
+        emitted = 0
+        rows = self.scheduler.decoding()
+        for req in rows:
             slot = req.slot
             for k in range(toks.shape[0]):
                 if req.finished:
                     break
-                req.record(int(toks[k, slot]), self.eos_token_id)
+                self._emit(req, int(toks[k, slot]))
+                emitted += 1
             if req.finished:
                 req.finish_time = now
+        self.obs.on_quantum("decode", t0, now, emitted, len(rows))
         self._retire_finished()
 
     def _retire_finished(self):
-        now = time.perf_counter()
+        now = self._now()
         for req in list(self.scheduler.live()):
             if req.finished:
                 slot = req.slot
                 if req.finish_time is None:
                     req.finish_time = now
                 self.stats["generated_tokens"] += len(req.tokens)
+                self.obs.on_retire(req, req.finish_time)
                 self._done[slot] = True
                 self._max_new[slot] = 0
                 self.scheduler.retire(req)

@@ -11,7 +11,8 @@ resnet50_jit | gpt2_jit | ernie_engine |
 sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
-serving_engine | speculative_decode | speculative_serving
+serving_engine | speculative_decode | speculative_serving |
+serving_obs_overhead
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -961,12 +962,22 @@ def speculative_serving():
     return _bench_serving().speculative_serving()
 
 
+def serving_obs_overhead():
+    """Runtime-observability cost gate (ISSUE 5): decode-quantum
+    throughput with full instrumentation (metrics registry + request
+    tracing) vs rich-hooks-off — must stay <3% on the CPU smoke
+    config; the compiled quantum is fingerprint-identical either way
+    (see scripts/bench_serving.py)."""
+    return _bench_serving().serving_obs_overhead()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
     "serving_engine": serving_engine,
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,
+    "serving_obs_overhead": serving_obs_overhead,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -17,4 +17,11 @@ export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}."
 
 python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/
 python -m paddle_tpu.analysis --check --fingerprint
-echo "check_graphs: lint + budgets + fingerprints all green"
+# Observability gate (ISSUE 5): rebuild the serving + speculative
+# recipes — whose engines run with FULL instrumentation (metrics
+# registry + request tracer) — and assert budgets (0 host callbacks,
+# donation) and golden fingerprints are UNCHANGED, i.e. the obs layer
+# provably never touches the compiled quantum. Also asserts the
+# instrumentation actually recorded (metrics counted, trace validates).
+python -m paddle_tpu.obs check
+echo "check_graphs: lint + budgets + fingerprints (+obs) all green"

@@ -45,6 +45,8 @@ def main():
             __import__("paddle_tpu.analysis", fromlist=["analysis"])),
         "serving.txt": _callables(
             __import__("paddle_tpu.serving", fromlist=["serving"])),
+        "obs.txt": _callables(
+            __import__("paddle_tpu.obs", fromlist=["obs"])),
     }
     for fname, names in sets.items():
         path = os.path.join(OUT, fname)

@@ -20,12 +20,15 @@ from paddle_tpu.analysis.lint import (
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 BAD_SOURCE = '''
+import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from time import perf_counter
 
 @jax.jit
 def step(x, y):
+    t0 = time.perf_counter()   # H106: wall clock constant-folds
     v = x + y
     if v.sum() > 0:            # H104: traced branch
         v = v * 2
@@ -34,6 +37,7 @@ def step(x, y):
     w = np.square(v)           # H103: numpy on traced
     while v.mean() < 1:        # H104
         v = v + 1
+    dt = perf_counter() - t0   # H106: bare from-import form
     return v
 
 def outer(xs):
@@ -47,6 +51,7 @@ def helper(a, b=[]):           # H105: mutable default
 '''
 
 CLEAN_SOURCE = '''
+import time
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +59,12 @@ import numpy as np
 def eager_api(t):
     # host-side eager op: .numpy()/float() are its JOB, not a hazard
     return float(np.asarray(t.numpy()).sum())
+
+def boundary_instrument(engine):
+    # wall clock OUTSIDE any jit scope: quantum-boundary telemetry
+    t0 = time.perf_counter()
+    engine.step()
+    return time.perf_counter() - t0
 
 @jax.jit
 def clean(x, eos=None):
@@ -80,12 +91,16 @@ def _rules(violations):
 
 def test_known_bad_source_trips_every_rule():
     vs = lint_source(BAD_SOURCE, "bad.py")
-    assert _rules(vs) == ["H101", "H102", "H103", "H104", "H105"]
+    assert _rules(vs) == ["H101", "H102", "H103", "H104", "H105",
+                         "H106"]
     # nested scan body is jit-scoped through the lexical chain
     assert any(v.qualname == "outer.body" and v.rule == "H101"
                for v in vs)
     # two H104s: the if and the while
     assert sum(1 for v in vs if v.rule == "H104") == 2
+    # two H106s: the time.perf_counter attribute form AND the bare
+    # from-import form both constant-fold under tracing
+    assert sum(1 for v in vs if v.rule == "H106") == 2
 
 
 def test_known_clean_source_is_unflagged():

@@ -24,6 +24,8 @@ NAMESPACES = {
         "paddle_tpu.analysis", fromlist=["analysis"]),
     "serving.txt": lambda: __import__(
         "paddle_tpu.serving", fromlist=["serving"]),
+    "obs.txt": lambda: __import__(
+        "paddle_tpu.obs", fromlist=["obs"]),
 }
 
 
