@@ -35,6 +35,13 @@ the scope's PARAMETERS are the traced values. Rules:
   silently reports the tracing wall clock forever after.
   Instrumentation belongs at quantum/step boundaries on the host
   (``paddle_tpu.obs``), never inside the compiled program.
+- **H107 metric mutation in jit scope** (companion to H106):
+  ``.inc(`` / ``.observe(`` / ``.set(`` — the obs registry's mutation
+  surface — inside a jit scope. The registry is host-side dict state:
+  under tracing the mutation runs ONCE at compile time and never
+  again, so the "metric" silently freezes at its tracing value.
+  jax's functional array update ``x.at[i].set(v)`` is recognized and
+  exempt.
 
 Known limits (by design, to stay fast and false-positive-light): the
 scope detection is lexical per module — a module-level helper that is
@@ -66,7 +73,13 @@ RULES = {
     "H105": "mutable default argument",
     "H106": "wall-clock read (time.time/perf_counter/monotonic) inside "
             "a jit scope — constant-folds into the trace",
+    "H107": "metric mutation (.inc/.observe/.set) inside a jit scope — "
+            "runs once at trace time, then silently freezes",
 }
+
+# the obs registry's mutation surface (Counter.inc / Histogram.observe
+# / Gauge.set); `.at[...].set(...)` is jax's functional update, exempt
+_METRIC_MUTATION_ATTRS = ("inc", "observe", "set")
 
 # wall-clock reads that constant-fold under tracing: the time-module
 # attribute forms plus their bare from-import names
@@ -424,6 +437,19 @@ class _TaintChecker:
                         f".{node.func.attr}() on "
                         f"{ast.unparse(node.func.value)[:40]}")
                 continue
+            # H107: obs metric mutation — host dict state frozen into
+            # the trace (x.at[idx].set(v) is functional, not a metric)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _METRIC_MUTATION_ATTRS:
+                recv = node.func.value
+                at_update = (isinstance(recv, ast.Subscript)
+                             and isinstance(recv.value, ast.Attribute)
+                             and recv.value.attr == "at")
+                if not at_update:
+                    self._flag(
+                        "H107", node,
+                        f"{ast.unparse(node.func)[:50]}(...)")
+                    continue
             callee = _dotted(node.func)
             # H106: wall-clock read — hazardous REGARDLESS of taint
             # (the clock needs no traced operand to constant-fold)

@@ -172,14 +172,14 @@ def _build_serving_decode_step():
     paddle.seed(0)
     cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
     model = LlamaForCausalLM(cfg)
-    # FULL observability on (metrics + tracer): instrumentation lives
-    # at host boundaries only, so the audited program and its golden
-    # fingerprint must be byte-identical to the uninstrumented engine —
-    # this recipe IS that proof (tier-1 + `python -m paddle_tpu.obs
-    # check` + scripts/check_graphs.sh)
+    # FULL observability on (metrics + tracer + SLOs + flight
+    # recorder): instrumentation lives at host boundaries only, so the
+    # audited program and its golden fingerprint must be byte-identical
+    # to the uninstrumented engine — this recipe IS that proof (tier-1
+    # + `python -m paddle_tpu.obs check` + scripts/check_graphs.sh)
     engine = ServingEngine(model, num_slots=2, block_size=4,
                            prefill_chunk=8, decode_quantum=4,
-                           trace=True)
+                           trace=True, slo=True, flight=True)
     rng = np.random.RandomState(0)
     engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
                   max_new_tokens=8)
@@ -215,10 +215,11 @@ def _build_speculative_verify_step():
     draft = LlamaForCausalLM(
         LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16",
                          num_hidden_layers=1))
-    # observability on, same rationale as serving_decode_step
+    # observability + SLO/flight on, same rationale as
+    # serving_decode_step
     engine = ServingEngine(target, spec_draft=draft, spec_gamma=2,
                            num_slots=2, block_size=4, prefill_chunk=8,
-                           trace=True)
+                           trace=True, slo=True, flight=True)
     rng = np.random.RandomState(0)
     engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
                   max_new_tokens=6)

@@ -155,7 +155,16 @@ def create_serving_engine(model, **kwargs):
     observability layer — metrics registry + Chrome-trace request
     spans via :mod:`paddle_tpu.obs`, all recorded at host scheduler
     boundaries (the jitted quantum's fingerprint is unchanged).
-    See :mod:`paddle_tpu.serving`."""
+    The operability tier rides the same boundaries: ``slo=True`` (or
+    an :class:`~paddle_tpu.obs.slo.SLOSet` / list of
+    :class:`~paddle_tpu.obs.slo.SLO`) attaches serving objectives —
+    ``engine.health()`` evaluates them with multi-window burn rates,
+    and :class:`~paddle_tpu.obs.export.MetricsExporter` serves the
+    report live over ``/metrics`` / ``/healthz`` / ``/slo`` — and
+    ``flight=True`` (or a
+    :class:`~paddle_tpu.obs.flight.FlightRecorder`) journals every
+    request's lifecycle, dumping the journal on SLO-threshold
+    crossings. See :mod:`paddle_tpu.serving`."""
     from ..serving import ServingEngine
 
     return ServingEngine(model, **kwargs)

@@ -21,6 +21,16 @@ Layout:
   ``engine.stats`` compatibility view.
 - :mod:`.train` — :class:`InstrumentedTrainStep`: step time, tokens/s
   and MFU (via :mod:`paddle_tpu.profiler.mfu`) into the same registry.
+- :mod:`.slo` — declarative :class:`SLO` objectives evaluated with
+  SRE-style multi-window burn rates over the serving sample series;
+  ordered ``OK < WARN < CRITICAL`` health (:class:`HealthState`).
+- :mod:`.export` — :class:`MetricsExporter`: stdlib threaded HTTP
+  server exposing live ``/metrics`` (Prometheus text), ``/healthz``
+  (SLO state + status code), ``/slo``, ``/snapshot``, ``/anomalies``;
+  plus the ``watch`` terminal-dashboard renderer.
+- :mod:`.flight` — :class:`FlightRecorder`: bounded per-request
+  lifecycle journals with dump-on-anomaly (SLO threshold crossings)
+  to schema-validated JSONL.
 
 The hard invariant, enforced by the golden-fingerprint gate: every
 hook runs on the host at a quantum/step boundary — the jitted decode
@@ -31,7 +41,11 @@ CLI::
 
     python -m paddle_tpu.obs snapshot --demo --format prom
     python -m paddle_tpu.obs export --demo --out /tmp/trace.json
+    python -m paddle_tpu.obs serve --demo --port 9100   # live exporter
+    python -m paddle_tpu.obs slo --demo                 # health report
+    python -m paddle_tpu.obs watch --url http://127.0.0.1:9100
     python -m paddle_tpu.obs check   # instrumented fingerprint gate
+                                     # + SLO/flight smoke
 """
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, LATENCY_BUCKETS, MetricsRegistry,
@@ -42,10 +56,22 @@ from .trace import (  # noqa: F401
 )
 from .serving import ServingObs  # noqa: F401
 from .train import InstrumentedTrainStep  # noqa: F401
+from .slo import (  # noqa: F401
+    CRITICAL, OK, WARN, HealthState, SLO, SLOSet,
+    default_serving_slos, state_of, worst_state,
+)
+from .flight import (  # noqa: F401
+    FlightRecorder, load_flight_records, validate_flight_records,
+)
+from .export import MetricsExporter, render_dashboard  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "LATENCY_BUCKETS",
     "MetricsRegistry", "prometheus_from_snapshot",
     "TraceRecorder", "load_chrome_trace", "validate_chrome_trace",
     "ServingObs", "InstrumentedTrainStep",
+    "HealthState", "OK", "WARN", "CRITICAL", "state_of", "worst_state",
+    "SLO", "SLOSet", "default_serving_slos",
+    "FlightRecorder", "validate_flight_records", "load_flight_records",
+    "MetricsExporter", "render_dashboard",
 ]

@@ -5,6 +5,11 @@
     python -m paddle_tpu.obs export --demo --out trace.json \
         [--metrics-out metrics.json] [--spec]
     python -m paddle_tpu.obs export --in trace.json      # validate
+    python -m paddle_tpu.obs serve --demo [--port 9100] [--duration S]
+    python -m paddle_tpu.obs slo --demo [--out series.json]
+    python -m paddle_tpu.obs slo --in series.json [--fail-on critical]
+    python -m paddle_tpu.obs watch --url http://127.0.0.1:9100
+    python -m paddle_tpu.obs watch --in metrics.json [--slo-in rep.json]
     python -m paddle_tpu.obs check                       # CI gate
 
 ``snapshot`` renders a metrics snapshot (live from the ``--demo``
@@ -13,21 +18,35 @@ Prometheus text or stable-sorted JSON. ``export`` writes/validates the
 Chrome trace-event JSON (open in Perfetto / chrome://tracing); with
 ``--demo`` it drives a tiny CPU serving engine (``--spec`` switches it
 to the speculative arm) so the artifact carries real request spans.
+
+The operability tier (ISSUE 6): ``serve`` runs the live HTTP exporter
+(obs/export.py — ``/metrics`` ``/healthz`` ``/slo`` ``/snapshot``
+``/anomalies``) over the demo engine; ``slo`` evaluates the burn-rate
+health report (live from ``--demo``, or offline from a saved
+``series_snapshot`` via ``--in``; ``--fail-on warn|critical`` turns
+the state into an exit code for scripts); ``watch`` renders the
+terminal dashboard — polling a running exporter's ``/snapshot`` +
+``/slo`` with ``--url``, or one frame from saved files with ``--in``.
+
 ``check`` is the instrumentation-can't-change-the-graph gate used by
 ``scripts/check_graphs.sh``: it builds the serving + speculative
 analysis recipes — whose engines run with FULL observability (registry
-+ tracer) — re-checks their budgets, compares the golden fingerprints,
-and asserts the instrumentation actually recorded (metrics counted,
-trace validates). Exit non-zero on drift.
++ tracer + SLOs + flight recorder) — re-checks their budgets, compares
+the golden fingerprints, and asserts the instrumentation actually
+recorded (metrics counted, trace validates). It then runs the SLO
+smoke on the demo engine: lenient objectives must read ``ok``,
+impossible ones ``critical``, and forced threshold crossings must
+produce schema-valid anomaly journals. Exit non-zero on drift.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import time
 
 
-def _demo_engine(spec=False, trace=True):
+def _demo_engine(spec=False, trace=True, slo=None, flight=None):
     """A tiny CPU serving run with full instrumentation: a handful of
     ragged requests through prefill/decode (+ the speculative arm),
     enough to populate every serving metric and trace track."""
@@ -48,7 +67,7 @@ def _demo_engine(spec=False, trace=True):
             spec_gamma=2)
     engine = ServingEngine(model, num_slots=3, block_size=4,
                            prefill_chunk=4, decode_quantum=3,
-                           trace=trace, **kw)
+                           trace=trace, slo=slo, flight=flight, **kw)
     rng = np.random.RandomState(0)
     for n, mn in ((5, 6), (9, 4), (3, 8), (12, 5)):
         engine.submit(rng.randint(1, cfg.vocab_size, n)
@@ -106,7 +125,148 @@ def _cmd_export(args):
     return 2
 
 
+def _cmd_serve(args):
+    """Live exporter over the demo engine: the zero-to-scrape path —
+    run it, point a browser / curl / Prometheus at the printed URLs."""
+    from .export import MetricsExporter
+
+    engine = _demo_engine(spec=args.spec, trace=False, slo=True,
+                          flight=True)
+    exporter = MetricsExporter.for_engine(
+        engine, host=args.host, port=args.port).start()
+    for route in exporter.routes():
+        print(f"serving {exporter.url(route)}", file=sys.stderr)
+    try:
+        if args.duration is not None:
+            time.sleep(args.duration)
+        else:
+            print("Ctrl-C to stop", file=sys.stderr)
+            while True:
+                time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        exporter.stop()
+    return 0
+
+
+def _cmd_slo(args):
+    """Burn-rate health report: live from the demo engine, or offline
+    from a saved ``ServingObs.series_snapshot()`` dump."""
+    from .slo import SLOSet, state_of
+
+    if args.demo:
+        engine = _demo_engine(spec=args.spec, trace=False, slo=True,
+                              flight=True)
+        report = engine.health()
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(engine.obs.series_snapshot(), f,
+                          sort_keys=True)
+            print(f"wrote {args.out}", file=sys.stderr)
+    elif args.infile:
+        with open(args.infile) as f:
+            snap = json.load(f)
+        if snap.get("version") != 1 or "series" not in snap:
+            print(f"slo: {args.infile} is not a series snapshot "
+                  f"(need version=1 + 'series'; write one with "
+                  f"`slo --demo --out FILE`)", file=sys.stderr)
+            return 2
+        report = SLOSet().evaluate(snap["series"], now=snap.get("now"))
+    else:
+        print("slo: need --demo or --in FILE", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if args.fail_on and state_of(report["state"]) >= args.fail_on:
+        print(f"slo: state {report['state']} >= --fail-on "
+              f"{args.fail_on}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cmd_watch(args):
+    """Terminal dashboard: poll a live exporter (``--url``) or render
+    one frame from saved snapshot/report files (``--in``)."""
+    from .export import render_dashboard
+
+    def frame():
+        if args.url:
+            from urllib.request import urlopen
+
+            base = args.url.rstrip("/")
+            with urlopen(base + "/snapshot") as r:
+                snap = json.load(r)
+            with urlopen(base + "/slo") as r:
+                report = json.load(r)
+            return snap, report
+        with open(args.infile) as f:
+            snap = json.load(f)
+        report = None
+        if args.slo_in:
+            with open(args.slo_in) as f:
+                report = json.load(f)
+        return snap, report
+
+    if not args.url and not args.infile:
+        print("watch: need --url BASE or --in metrics.json",
+              file=sys.stderr)
+        return 2
+    frames = args.frames if args.frames is not None \
+        else (0 if args.url else 1)  # 0 == until interrupted
+    n = 0
+    try:
+        while True:
+            snap, report = frame()
+            if n and args.url:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear between polls
+            sys.stdout.write(render_dashboard(snap, report))
+            sys.stdout.flush()
+            n += 1
+            if frames and n >= frames:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 _CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step")
+
+
+def _check_slo_smoke():
+    """The operability-tier smoke `check` appends to the fingerprint
+    gate: drive the demo engine with SLOs + a flight recorder whose
+    triggers are impossible to satisfy, then assert the burn-rate
+    evaluation orders states correctly on BOTH sides of a threshold
+    and every forced crossing produced a schema-valid journal."""
+    from .flight import FlightRecorder
+    from .slo import SLOSet, default_serving_slos
+
+    engine = _demo_engine(
+        trace=False, slo=True,
+        flight=FlightRecorder(ttft_threshold=1e-9, e2e_threshold=1e-9))
+    finished = len(engine.completed)
+    lenient = SLOSet(default_serving_slos(
+        ttft_p95_s=1e9, inter_token_p99_s=1e9, e2e_p99_s=1e9))
+    tight = SLOSet(default_serving_slos(
+        ttft_p95_s=1e-9, inter_token_p99_s=1e-9, e2e_p99_s=1e-9))
+    ok = lenient.evaluate(engine.obs)["state"]
+    crit = tight.evaluate(engine.obs)["state"]
+    if ok != "ok":
+        raise AssertionError(
+            f"lenient SLOs read {ok!r}, expected 'ok'")
+    if crit != "critical":
+        raise AssertionError(
+            f"impossible SLOs read {crit!r}, expected 'critical'")
+    records = engine.flight.records()  # schema-validates
+    if len(records) != finished:
+        raise AssertionError(
+            f"{len(records)} anomaly journals for {finished} forced "
+            f"threshold crossings")
+    report = engine.health()  # stock objectives, real state
+    print(f"slo smoke: lenient=ok impossible=critical "
+          f"stock={report['state']}, {len(records)} schema-valid "
+          f"anomaly journals for {finished} requests")
 
 
 def _cmd_check(args):
@@ -146,6 +306,11 @@ def _cmd_check(args):
             print(f"{name}: FAIL — {e}", file=sys.stderr)
         finally:
             recipe.close()
+    try:
+        _check_slo_smoke()
+    except (AssertionError, ValueError) as e:
+        failed = True
+        print(f"slo smoke: FAIL — {e}", file=sys.stderr)
     if failed:
         return 1
     print("obs check: instrumentation-enabled fingerprints unchanged")
@@ -181,8 +346,47 @@ def main(argv=None):
                    help="also dump the demo registry snapshot here")
     p.set_defaults(fn=_cmd_export)
 
+    p = sub.add_parser("serve",
+                       help="live HTTP exporter over the demo engine")
+    p.add_argument("--demo", action="store_true", default=True,
+                   help="(implied) drive the demo engine")
+    p.add_argument("--spec", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9100)
+    p.add_argument("--duration", type=float, default=None,
+                   help="serve for N seconds then exit "
+                        "(default: until Ctrl-C)")
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("slo",
+                       help="evaluate the burn-rate health report")
+    p.add_argument("--demo", action="store_true")
+    p.add_argument("--spec", action="store_true")
+    p.add_argument("--in", dest="infile", default=None,
+                   help="saved series snapshot (slo --demo --out)")
+    p.add_argument("--out", default=None,
+                   help="with --demo: also dump the series snapshot")
+    p.add_argument("--fail-on", choices=("warn", "critical"),
+                   default=None,
+                   help="exit 1 when the state reaches this level")
+    p.set_defaults(fn=_cmd_slo)
+
+    p = sub.add_parser("watch", help="terminal health dashboard")
+    p.add_argument("--url", default=None,
+                   help="base URL of a running exporter (serve)")
+    p.add_argument("--in", dest="infile", default=None,
+                   help="saved registry snapshot JSON")
+    p.add_argument("--slo-in", dest="slo_in", default=None,
+                   help="saved /slo report JSON (with --in)")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--frames", type=int, default=None,
+                   help="stop after N frames (default: loop on --url, "
+                        "1 on --in)")
+    p.set_defaults(fn=_cmd_watch)
+
     p = sub.add_parser("check",
-                       help="instrumented-fingerprint CI gate")
+                       help="instrumented-fingerprint CI gate "
+                            "+ SLO/flight smoke")
     p.add_argument("--recipe", action="append", default=None,
                    choices=_CHECK_RECIPES)
     p.set_defaults(fn=_cmd_check)

@@ -5,8 +5,9 @@ the serving stack exports, ``paddle/fluid/platform/monitor.h`` and the
 
 Three instrument kinds, all label-aware:
 
-- :class:`Counter` — monotonically increasing float (resettable only
-  through the legacy stats view / benchmarks via ``_set``).
+- :class:`Counter` — monotonically increasing float (resettable
+  through the legacy stats view's ``_set`` or the explicit
+  bench-warmup :meth:`MetricsRegistry.reset`).
 - :class:`Gauge` — last-write-wins scalar.
 - :class:`Histogram` — FIXED upper-bound buckets declared at creation
   (never rebucketed at runtime: observation cost is one bisect + two
@@ -69,6 +70,13 @@ class _Metric:
 
     def _labels_of(self, key):
         return {k: v for k, v in key}
+
+    def reset(self):
+        """Drop every recorded series (the instrument and its buckets
+        stay registered). The bench-warmup reset: clears counters,
+        gauges AND histogram observations in one call, replacing the
+        old hand-zeroing through the legacy stats view."""
+        self._series.clear()
 
 
 class Counter(_Metric):
@@ -217,6 +225,13 @@ class MetricsRegistry:
 
     def names(self):
         return sorted(self._metrics)
+
+    def reset(self):
+        """Reset every registered instrument (see
+        :meth:`_Metric.reset`): one call returns the registry to its
+        just-registered state between bench warmup and timed phases."""
+        for m in self._metrics.values():
+            m.reset()
 
     # -- export ------------------------------------------------------------
     def snapshot(self):

@@ -33,7 +33,11 @@ Exported serving metrics (all host-boundary):
   free_blocks,utilization}{pool=target|draft}``.
 - time series (host ring buffers, not prometheus):
   :meth:`timeseries` — ``tokens_per_s`` and ``spec_acceptance_rate``
-  points for offline plots.
+  points for offline plots, plus the PER-REQUEST sample series the SLO
+  layer's burn-rate windows evaluate (obs/slo.py): ``ttft_seconds``,
+  ``e2e_latency_seconds``, ``inter_token_seconds`` as ``(t, value)``
+  points, and ``request_outcomes`` as ``(t, bad)`` where bad is 1.0
+  for a shed/error outcome and 0.0 for eos/length.
 """
 from __future__ import annotations
 
@@ -162,11 +166,19 @@ class ServingObs:
         self._g_util = r.gauge(
             "serving_pool_utilization",
             "live tokens / allocated token capacity")
+        self._c_shed = r.counter(
+            "serving_requests_shed_total",
+            "requests refused by load shedding")
         self._window = deque()
         self._cum_tokens = 0
         self._series = {
             "tokens_per_s": deque(maxlen=series_maxlen),
             "spec_acceptance_rate": deque(maxlen=series_maxlen),
+            # per-request samples the SLO burn-rate windows read
+            "ttft_seconds": deque(maxlen=series_maxlen),
+            "e2e_latency_seconds": deque(maxlen=series_maxlen),
+            "inter_token_seconds": deque(maxlen=series_maxlen),
+            "request_outcomes": deque(maxlen=series_maxlen),
         }
 
     # the engine's single clock (the old code had six scattered
@@ -180,8 +192,35 @@ class ServingObs:
 
     def timeseries(self):
         """{"tokens_per_s": [(t, v), ...], "spec_acceptance_rate":
-        [...]} — host ring buffers for offline plotting."""
+        [...], "ttft_seconds": [...], "e2e_latency_seconds": [...],
+        "inter_token_seconds": [...], "request_outcomes": [...]} —
+        host ring buffers for offline plotting and the SLO layer's
+        burn-rate windows (obs/slo.py)."""
         return {k: list(v) for k, v in self._series.items()}
+
+    def series_snapshot(self, now=None):
+        """JSON-able dump of :meth:`timeseries` plus the clock stamp a
+        later offline SLO evaluation anchors its windows to (the
+        ``python -m paddle_tpu.obs slo --in`` format)."""
+        return {
+            "version": 1,
+            "now": self.now() if now is None else float(now),
+            "series": {k: [[float(t), float(v)] for t, v in pts]
+                       for k, pts in self._series.items()},
+        }
+
+    def reset(self):
+        """Return every surface to its initial state between bench
+        warmup and timed phases: registry series
+        (:meth:`MetricsRegistry.reset` — counters, gauges AND
+        histograms), the throughput window, and the ring-buffer time
+        series. Replaces the old per-key zeroing through
+        ``engine.stats``."""
+        self.registry.reset()
+        self._window.clear()
+        self._cum_tokens = 0
+        for s in self._series.values():
+            s.clear()
 
     # -- request lifecycle hooks -------------------------------------------
     def on_submit(self, req):
@@ -210,7 +249,9 @@ class ServingObs:
         request by construction."""
         if not self.enabled:
             return
-        self._h_ttft.observe(now - req.arrival_time)
+        ttft = now - req.arrival_time
+        self._h_ttft.observe(ttft)
+        self._series["ttft_seconds"].append((now, ttft))
         if self.tracer is not None:
             self.tracer.instant("first_token", now, tid=req.slot + 1,
                                 args={"req": str(req.req_id)})
@@ -224,17 +265,38 @@ class ServingObs:
         if not self.enabled:
             return
         self._c_finished.inc()
-        self._h_e2e.observe(now - req.arrival_time)
+        e2e = now - req.arrival_time
+        self._h_e2e.observe(e2e)
+        self._series["e2e_latency_seconds"].append((now, e2e))
+        # outcome sample for the error/shed-rate SLO: eos/length are
+        # the good endings, anything else is a bad one
+        self._series["request_outcomes"].append(
+            (now, 0.0 if req.finish_reason in ("eos", "length")
+             else 1.0))
         n = len(req.tokens)
         if req.first_token_time is not None and n >= 2:
-            self._h_itl.observe(
-                (req.finish_time - req.first_token_time) / (n - 1))
+            itl = (req.finish_time - req.first_token_time) / (n - 1)
+            self._h_itl.observe(itl)
+            self._series["inter_token_seconds"].append((now, itl))
         if self.tracer is not None and req.slot is not None:
             self.tracer.complete(
                 f"req {req.req_id}", req.admit_time or now, now,
                 tid=req.slot + 1,
                 args={"tokens": n, "reason": req.finish_reason,
                       "prompt_len": req.prompt_len})
+
+    def on_shed(self, req, now):
+        """A request refused admission by a load-shedding policy (the
+        SLO-driven scheduler this layer feeds): counted, and recorded
+        as a BAD outcome sample so the error/shed-rate objective burns
+        budget for it."""
+        if not self.enabled:
+            return
+        self._c_shed.inc()
+        self._series["request_outcomes"].append((now, 1.0))
+        if self.tracer is not None:
+            self.tracer.instant("shed", now, tid=0,
+                                args={"req": str(req.req_id)})
 
     # -- step / dispatch hooks ---------------------------------------------
     def on_step(self, now, live, num_slots, pool, d_pool=None):

@@ -49,6 +49,13 @@ programs keep ``max_host_callbacks=0`` and byte-identical golden
 fingerprints with observability enabled — asserted by the
 ``serving_decode_step`` / ``speculative_verify_step`` recipes, which
 build THIS engine with full instrumentation on.
+
+The operability tier rides the same boundaries: ``slo=`` attaches
+declarative objectives evaluated with multi-window burn rates
+(``engine.health()``, served live by obs/export.py's ``/healthz`` /
+``/slo``), and ``flight=`` a per-request flight recorder whose
+journals dump on SLO-threshold crossings (obs/flight.py) — so a slow
+tail request is explainable, not just a histogram bucket.
 """
 from __future__ import annotations
 
@@ -63,7 +70,9 @@ from ..core import autograd
 from ..jit import functional_call
 from ..nlp.generation import _filter_logits
 from ..nlp.paged_cache import PagedKVCachePool
+from ..obs.flight import FlightRecorder
 from ..obs.serving import ServingObs
+from ..obs.slo import SLOSet
 from .scheduler import Request, Scheduler, SchedulerConfig
 
 __all__ = ["ServingEngine"]
@@ -321,13 +330,32 @@ class ServingEngine:
             quantum spans, occupancy/pool counter tracks) into
             ``engine.obs.tracer`` — export with
             ``engine.obs.tracer.save(path)``, open in Perfetto.
+        slo: serving objectives (:mod:`paddle_tpu.obs.slo`) —
+            ``True`` attaches the stock set (p95 TTFT, p99 inter-token,
+            p99 e2e, error/shed rate), or pass an
+            :class:`~paddle_tpu.obs.slo.SLOSet` / list of
+            :class:`~paddle_tpu.obs.slo.SLO`. ``engine.health()``
+            evaluates them with multi-window burn rates over the obs
+            sample series; the exporter's ``/healthz`` & ``/slo``
+            endpoints (obs/export.py) serve the same report live.
+        flight: per-request flight recorder
+            (:mod:`paddle_tpu.obs.flight`) — ``True`` builds one whose
+            dump-on-anomaly thresholds come from ``slo``, or pass a
+            :class:`~paddle_tpu.obs.flight.FlightRecorder`. Journals
+            every lifecycle event (submit/admit/prefill chunks/first
+            token/quantum yields/spec rounds/retire) at host scheduler
+            boundaries; a request crossing its TTFT/e2e SLO threshold
+            dumps its full journal to ``engine.flight.anomalies``.
+            Like every obs hook, the compiled quantum is untouched
+            (fingerprint-gated).
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
                  max_context=None, prefill_chunk=64, decode_quantum=8,
                  decode_strategy="greedy", top_k=0, top_p=1.0,
                  temperature=1.0, eos_token_id=None, spec_draft=None,
-                 spec_gamma=4, obs=None, trace=False):
+                 spec_gamma=4, obs=None, trace=False, slo=None,
+                 flight=None):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -457,6 +485,21 @@ class ServingEngine:
                 self.obs.tracer = TraceRecorder()
         self._now = self.obs.now
         self.stats = self.obs.legacy_stats_view()
+        # SLO + flight recorder (the operability tier over the obs
+        # boundaries): health for a future scheduler/shedder, and the
+        # journal that explains a slow tail request after the fact
+        if slo is True:
+            self.slo = SLOSet()
+        elif slo is None or isinstance(slo, SLOSet):
+            self.slo = slo
+        else:
+            self.slo = SLOSet(slo)
+        if flight is True:
+            self.flight = FlightRecorder(slo=self.slo)
+        elif flight is None or flight is False:
+            self.flight = None
+        else:
+            self.flight = flight
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, req_id=None, seed=0,
@@ -473,8 +516,15 @@ class ServingEngine:
                 f"request needs {total} tokens > max_context "
                 f"{self.max_context}")
         self.scheduler.submit(req)
-        self.obs.on_submit(req)
+        self._on_submitted(req)
         return req
+
+    def _on_submitted(self, req):
+        """Observability fan-out for one queued request (req_id is
+        assigned by the scheduler, so this runs after its submit)."""
+        self.obs.on_submit(req)
+        if self.flight is not None:
+            self.flight.on_submit(req, req.arrival_time)
 
     @property
     def has_work(self):
@@ -503,7 +553,7 @@ class ServingEngine:
             for r in requests:
                 if isinstance(r, Request):
                     self.scheduler.submit(r)
-                    self.obs.on_submit(r)
+                    self._on_submitted(r)
                 elif isinstance(r, dict):
                     self.submit(**r)
                 else:
@@ -539,12 +589,32 @@ class ServingEngine:
         with the engine's live state as the example batch."""
         return self._audited, self._quantum_args()
 
+    def health(self, now=None):
+        """Evaluate the engine's SLOs over the obs sample series: the
+        multi-window burn-rate report (state ``ok``/``warn``/
+        ``critical`` + per-objective windows) the exporter's
+        ``/healthz`` endpoint and a shedding scheduler consume. The
+        engine must have been built with ``slo=``."""
+        if self.slo is None:
+            raise ValueError(
+                "engine built without slo=: pass slo=True (stock "
+                "objectives) or an SLOSet to evaluate health")
+        return self.slo.evaluate(self.obs, now=now)
+
     # -- admission + prefill ----------------------------------------------
     def _admit(self):
         now = self._now()
         for req in self.scheduler.try_admit():
             req.admit_time = now
             self.obs.on_admit(req, now)
+            if self.flight is not None:
+                st = self.pool.fragmentation_stats()
+                self.flight.on_admit(
+                    req, now, queue_wait=now - req.arrival_time,
+                    blocks_reserved=self.scheduler._reservations.get(
+                        req),
+                    pool_free_blocks=st["free_blocks"],
+                    pool_blocks_in_use=st["blocks_in_use"])
             slot = req.slot
             self._seq_lens[slot] = 0
             self._n_gen[slot] = 0
@@ -681,10 +751,16 @@ class ServingEngine:
             if i < len(pre):
                 req.prefill_pos += this_time[i]
                 self._seq_lens[slot] = req.prefill_pos
+                if self.flight is not None:
+                    self.flight.on_prefill_chunk(
+                        req, now, this_time[i], req.prefill_pos)
                 if req.prefill_pos >= req.prompt_len:
                     tok = int(nxt[need.index(i)])
                     req.first_token_time = now
                     self.obs.on_first_token(req, now)
+                    if self.flight is not None:
+                        self.flight.on_first_token(
+                            req, now, now - req.arrival_time)
                     self._emit(req, tok)
                     emitted += 1
                     self._record_host(slot, req, tok)
@@ -838,11 +914,17 @@ class ServingEngine:
         emitted = 0
         for req in rows:
             slot = req.slot
+            got = 0
             for k in range(int(counts[slot])):
                 if req.finished:
                     break
                 self._emit(req, int(stream[slot, k]))
                 emitted += 1
+                got += 1
+            if self.flight is not None:
+                self.flight.on_spec_round(
+                    req, now, proposed=g, accepted=int(acc[slot]),
+                    emitted=got)
             if req.finished:
                 req.finish_time = now
         self.obs.on_quantum("spec_round", t0, now, emitted, len(rows))
@@ -885,11 +967,15 @@ class ServingEngine:
         rows = self.scheduler.decoding()
         for req in rows:
             slot = req.slot
+            got = 0
             for k in range(toks.shape[0]):
                 if req.finished:
                     break
                 self._emit(req, int(toks[k, slot]))
                 emitted += 1
+                got += 1
+            if self.flight is not None and got:
+                self.flight.on_quantum_tokens(req, now, got)
             if req.finished:
                 req.finish_time = now
         self.obs.on_quantum("decode", t0, now, emitted, len(rows))
@@ -904,6 +990,14 @@ class ServingEngine:
                     req.finish_time = now
                 self.stats["generated_tokens"] += len(req.tokens)
                 self.obs.on_retire(req, req.finish_time)
+                if self.flight is not None:
+                    self.flight.on_retire(
+                        req, req.finish_time,
+                        ttft=(req.first_token_time - req.arrival_time
+                              if req.first_token_time is not None
+                              else None),
+                        e2e=req.finish_time - req.arrival_time,
+                        reason=req.finish_reason)
                 self._done[slot] = True
                 self._max_new[slot] = 0
                 self.scheduler.retire(req)

@@ -12,7 +12,7 @@ sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
 serving_engine | speculative_decode | speculative_serving |
-serving_obs_overhead
+serving_obs_overhead | slo_overhead
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -971,6 +971,15 @@ def serving_obs_overhead():
     return _bench_serving().serving_obs_overhead()
 
 
+def slo_overhead():
+    """Operability-tier cost gate (ISSUE 6): decode-quantum throughput
+    with per-dispatch SLO burn-rate evaluation + flight-recorder
+    journaling (anomaly capture forced) vs obs="off" — same <3% bar
+    and fingerprint-identical quantum as serving_obs_overhead (see
+    scripts/bench_serving.py, artifact BENCH_SLO_r09.json)."""
+    return _bench_serving().slo_overhead()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -978,6 +987,7 @@ CONFIGS = {
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,
     "serving_obs_overhead": serving_obs_overhead,
+    "slo_overhead": slo_overhead,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -17,11 +17,15 @@ export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}."
 
 python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/
 python -m paddle_tpu.analysis --check --fingerprint
-# Observability gate (ISSUE 5): rebuild the serving + speculative
+# Observability gate (ISSUE 5 + 6): rebuild the serving + speculative
 # recipes — whose engines run with FULL instrumentation (metrics
-# registry + request tracer) — and assert budgets (0 host callbacks,
-# donation) and golden fingerprints are UNCHANGED, i.e. the obs layer
-# provably never touches the compiled quantum. Also asserts the
-# instrumentation actually recorded (metrics counted, trace validates).
+# registry + request tracer + SLOs + flight recorder) — and assert
+# budgets (0 host callbacks, donation) and golden fingerprints are
+# UNCHANGED, i.e. the obs layer provably never touches the compiled
+# quantum. Also asserts the instrumentation actually recorded (metrics
+# counted, trace validates), then runs the SLO-evaluation smoke on the
+# demo engine: lenient objectives read ok, impossible ones critical,
+# and every forced threshold crossing dumps a schema-valid flight
+# journal.
 python -m paddle_tpu.obs check
 echo "check_graphs: lint + budgets + fingerprints (+obs) all green"

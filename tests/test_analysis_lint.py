@@ -132,6 +132,53 @@ def f(x, n):
     assert [v.rule for v in vs] == ["H101"]
 
 
+def test_h107_metric_mutation_in_jit_scope():
+    """ISSUE 6 satellite: obs mutation calls (.inc/.observe/.set on
+    registry metrics) inside a jit scope silently constant-fold — one
+    recording at trace time, frozen forever after — while jax's
+    functional ``x.at[i].set(v)`` update must stay exempt."""
+    src = '''
+import jax
+import jax.numpy as jnp
+
+COUNTER = get_counter()
+
+@jax.jit
+def step(x, hist, gauge):
+    COUNTER.inc()                    # H107: runs once at trace time
+    hist.observe(float(x.shape[0])) # H107 (shape arg is static, but
+    gauge.set(1.0, pool="target")   # H107  the mutation still freezes)
+    y = x.at[0].set(0.0)            # NOT flagged: functional update
+    z = x.at[0, 1].set(x.sum())     # NOT flagged either
+    return y + z
+
+def boundary(engine, registry):
+    # outside any jit scope: this is exactly where obs belongs
+    registry.counter("steps").inc()
+    registry.histogram("lat").observe(0.01)
+    registry.gauge("slots").set(3)
+    return engine
+'''
+    vs = lint_source(src, "m.py")
+    assert [v.rule for v in vs] == ["H107"] * 3
+    assert all(v.qualname == "step" for v in vs)
+
+
+def test_h107_nested_scan_body():
+    src = '''
+import jax
+
+def quantum(metric, xs):
+    def body(carry, x):
+        metric.inc()     # H107 through the lexical jit chain
+        return carry + x, x
+    return jax.lax.scan(body, 0.0, xs)
+'''
+    vs = lint_source(src, "m.py")
+    assert [(v.rule, v.qualname) for v in vs] == [("H107",
+                                                   "quantum.body")]
+
+
 def test_allowlist_roundtrip(tmp_path):
     allow = tmp_path / "allow.txt"
     allow.write_text(

@@ -201,8 +201,12 @@ def test_engine_metrics_ragged_slot_reuse(tiny_model):
 def test_engine_metrics_spec_decode(tiny_model):
     """The speculative arm: same invariants (TTFT once, streams match)
     plus acceptance-rate instrumentation consistent with the legacy
-    spec counters, and draft-pool gauges labeled separately."""
+    spec counters, and draft-pool gauges labeled separately. The
+    flight recorder rides along (ISSUE 6) with a forced e2e trigger so
+    every journal captures — its spec_round events must reconcile with
+    the engine's acceptance counters."""
     from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.obs import FlightRecorder
     from paddle_tpu.serving import ServingEngine
 
     cfg, model = tiny_model
@@ -212,7 +216,8 @@ def test_engine_metrics_spec_decode(tiny_model):
     draft.eval()
     engine = ServingEngine(model, spec_draft=draft, spec_gamma=2,
                            num_slots=2, block_size=4, prefill_chunk=3,
-                           trace=True)
+                           trace=True, slo=True,
+                           flight=FlightRecorder(e2e_threshold=1e-9))
     rng = np.random.RandomState(5)
     reqs = [engine.submit(rng.randint(1, cfg.vocab_size, n)
                           .astype(np.int32), max_new_tokens=5)
@@ -233,6 +238,21 @@ def test_engine_metrics_spec_decode(tiny_model):
         == engine.stats["spec_rounds"]
     assert r.get("serving_pool_blocks_in_use").value(pool="draft") >= 0
     validate_chrome_trace(engine.obs.tracer.chrome_trace())
+    # flight journals: every request captured (forced trigger), and
+    # their spec_round events reconcile with the engine's counters
+    recs = engine.flight.records()  # schema-validates
+    assert len(recs) == len(reqs)
+    spec_evs = [e for rec in recs for e in rec["events"]
+                if e["kind"] == "spec_round"]
+    assert spec_evs, "speculative rounds must be journaled"
+    assert all(0 <= e["accepted"] <= e["proposed"] == 2
+               for e in spec_evs)
+    assert (sum(e["accepted"] for e in spec_evs)
+            == engine.stats["spec_accepted"])
+    # health evaluates over the same run (state depends on wall clock;
+    # the report shape is the contract here)
+    assert {o["name"] for o in engine.health()["objectives"]} \
+        == {"ttft_p95", "inter_token_p99", "e2e_p99", "error_rate"}
 
 
 def test_engine_obs_off_is_inert(tiny_model):

@@ -1,0 +1,627 @@
+"""paddle_tpu.obs operability tier (ISSUE 6): SLO burn-rate health,
+the live HTTP exporter, and the per-request flight recorder.
+
+Three tiers, mirroring test_obs.py: pure-host unit tests (burn-rate
+math against hand-computed windows including the empty-window and
+clock-skew edges, health-state ordering, flight-recorder bounded
+buffers and JSONL schema round-trip, exporter e2e scrapes over a
+localhost ephemeral port with ``prometheus_from_snapshot`` parity and
+``/healthz`` status codes on BOTH sides of a threshold), one
+engine-integration fixture (a single tiny engine run shared by every
+engine test — quantum compiles are expensive) asserting
+``engine.health()`` and full-lifecycle anomaly journals, and the
+offline CLI paths (``slo --in``, ``watch --in``). The
+graph-can't-change half is asserted where the fingerprints live: the
+``serving_decode_step`` / ``speculative_verify_step`` recipes now
+build their engines with ``slo=True, flight=True``."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.obs import (
+    CRITICAL, OK, WARN, FlightRecorder, MetricsExporter,
+    MetricsRegistry, SLO, SLOSet, ServingObs, default_serving_slos,
+    load_flight_records, prometheus_from_snapshot, render_dashboard,
+    state_of, validate_flight_records, worst_state,
+)
+from paddle_tpu.serving.scheduler import Request
+
+
+# ------------------------------------------------- health-state order
+def test_health_state_total_order():
+    assert OK < WARN < CRITICAL
+    assert CRITICAL > WARN > OK
+    # compares against string names too (report consumers)
+    assert CRITICAL > "warn" and WARN >= "warn" and OK == "ok"
+    assert str(WARN) == "warn"
+    assert state_of("critical") is CRITICAL
+    assert worst_state([]) is OK
+    assert worst_state(["ok", "critical", "warn"]) is CRITICAL
+    with pytest.raises(ValueError, match="unknown health state"):
+        state_of("meh")
+
+
+# ------------------------------------------------- burn-rate math
+def test_burn_rate_hand_computed_windows():
+    """Window membership, bad fractions and burn rates checked against
+    hand-arithmetic: budget 0.1, fast window 2/4 bad -> burn 5.0, slow
+    window 2/10 bad -> burn 2.0; both >= warn(2) but fast < crit(8)
+    -> WARN."""
+    slo = SLO("ttft_p95", "ttft_seconds", threshold=0.1, target=0.9,
+              fast_window=300.0, slow_window=3600.0,
+              warn_burn=2.0, critical_burn=8.0)
+    now = 10_000.0
+    series = {"ttft_seconds": (
+        # inside the fast window: 4 samples, 2 over the 0.1s threshold
+        [(now - 10, 0.05), (now - 20, 0.2), (now - 100, 0.3),
+         (now - 300, 0.01)]            # age == window is IN (<=)
+        # slow-window-only: 6 good samples
+        + [(now - 1000 - i, 0.05) for i in range(6)]
+        # outside both windows: terrible, and correctly ignored
+        + [(now - 4000, 99.0)])}
+    rep = slo.evaluate(series, now=now)
+    fast, slow = rep["windows"]["fast"], rep["windows"]["slow"]
+    assert (fast["n"], fast["bad"]) == (4, 2)
+    assert fast["bad_fraction"] == pytest.approx(0.5)
+    assert fast["burn_rate"] == pytest.approx(5.0)
+    assert (slow["n"], slow["bad"]) == (10, 2)
+    assert slow["burn_rate"] == pytest.approx(2.0)
+    assert rep["state"] == "warn"
+    assert rep["budget"] == pytest.approx(0.1)
+
+
+def test_multiwindow_gating_suppresses_spike_and_stale():
+    """A short burst (fast hot, slow cold) and a long-ago incident
+    (slow hot, fast recovered) both read OK — the SRE rationale for
+    requiring BOTH windows to burn."""
+    slo = SLO("x", "ttft_seconds", threshold=0.1, target=0.9,
+              fast_window=10.0, slow_window=100.0,
+              warn_burn=2.0, critical_burn=8.0)
+    now = 1000.0
+    spike = {"ttft_seconds": [(now - 1, 1.0)] * 3
+             + [(now - 50 - 0.1 * i, 0.01) for i in range(97)]}
+    rep = slo.evaluate(spike, now=now)
+    assert rep["windows"]["fast"]["burn_rate"] >= 8.0
+    assert rep["windows"]["slow"]["burn_rate"] < 2.0
+    assert rep["state"] == "ok"
+    stale = {"ttft_seconds": [(now - 50, 1.0)] * 30
+             + [(now - 1 - 0.1 * i, 0.01) for i in range(30)]}
+    rep = slo.evaluate(stale, now=now)
+    assert rep["windows"]["slow"]["burn_rate"] >= 2.0
+    assert rep["state"] == "ok"
+    # both windows burning critical -> CRITICAL
+    rep = slo.evaluate({"ttft_seconds": [(now - 1, 1.0)] * 5}, now=now)
+    assert rep["state"] == "critical"
+
+
+def test_empty_window_burns_nothing():
+    """No traffic is not an outage: missing series, empty series, and
+    all-samples-aged-out all read n=0, burn 0.0, OK."""
+    slo = SLO("x", "e2e_latency_seconds", threshold=1.0, target=0.99)
+    for series, now in (({}, 5.0),
+                        ({"e2e_latency_seconds": []}, 5.0),
+                        ({"e2e_latency_seconds": [(0.0, 99.0)]}, 1e7)):
+        rep = slo.evaluate(series, now=now)
+        assert rep["state"] == "ok"
+        for w in rep["windows"].values():
+            assert w["n"] == 0 and w["burn_rate"] == 0.0
+
+
+def test_clock_skew_future_samples_count_as_now():
+    """A sample stamped AFTER the evaluation clock (skew across
+    threads/hosts) is clamped to age 0 and counted in every window —
+    never silently dropped."""
+    slo = SLO("x", "ttft_seconds", threshold=0.1, target=0.9,
+              warn_burn=2.0, critical_burn=8.0)
+    now = 100.0
+    rep = slo.evaluate({"ttft_seconds": [(now + 50.0, 5.0)]}, now=now)
+    for w in rep["windows"].values():
+        assert w["n"] == 1 and w["bad"] == 1
+    assert rep["state"] == "critical"
+
+
+def test_rate_objective_over_request_outcomes():
+    """error/shed rate: the series already records good(0)/bad(1), so
+    the bad fraction IS the rate; burn = rate / error budget."""
+    slo = SLO("err", "request_outcomes", target=0.99,
+              warn_burn=2.0, critical_burn=10.0)
+    now = 50.0
+    pts = [(now - i, 1.0 if i < 2 else 0.0) for i in range(10)]
+    rep = slo.evaluate({"request_outcomes": pts}, now=now)
+    fast = rep["windows"]["fast"]
+    assert (fast["n"], fast["bad"]) == (10, 2)
+    assert fast["burn_rate"] == pytest.approx(0.2 / 0.01)
+    assert rep["state"] == "critical"
+    # rate signals take no threshold
+    with pytest.raises(ValueError, match="no threshold"):
+        SLO("err", "request_outcomes", threshold=1.0)
+
+
+def test_slo_validation_is_loud():
+    with pytest.raises(ValueError, match="unknown signal"):
+        SLO("x", "nope")
+    with pytest.raises(ValueError, match="positive threshold"):
+        SLO("x", "ttft_seconds")
+    with pytest.raises(ValueError, match="target must be"):
+        SLO("x", "ttft_seconds", threshold=1.0, target=1.0)
+    with pytest.raises(ValueError, match="fast_window < slow_window"):
+        SLO("x", "ttft_seconds", threshold=1.0, fast_window=100,
+            slow_window=100)
+    with pytest.raises(ValueError, match="warn_burn <= critical_burn"):
+        SLO("x", "ttft_seconds", threshold=1.0, warn_burn=5,
+            critical_burn=2)
+    with pytest.raises(ValueError, match="duplicate SLO name"):
+        SLOSet([SLO("a", "ttft_seconds", threshold=1.0),
+                SLO("a", "e2e_latency_seconds", threshold=1.0)])
+
+
+def test_default_slo_set_and_threshold_lookup():
+    s = SLOSet()
+    assert {o.name for o in s} == {"ttft_p95", "inter_token_p99",
+                                   "e2e_p99", "error_rate"}
+    assert s.threshold("ttft_seconds") == 0.5
+    assert s.threshold("e2e_latency_seconds") == 30.0
+    assert s.threshold("request_outcomes") is None  # rate: no latency
+    rep = s.evaluate({}, now=1.0)
+    assert rep["version"] == 1 and rep["state"] == "ok"
+    assert len(rep["objectives"]) == 4
+    # the report is pure JSON
+    assert json.loads(json.dumps(rep)) == rep
+
+
+# ------------------------------------------------- obs sample series
+def _req(rid, prompt=3, max_new=4, arrival=0.0):
+    return Request(np.arange(1, prompt + 1, dtype=np.int32),
+                   max_new_tokens=max_new, req_id=rid,
+                   arrival_time=arrival)
+
+
+def test_serving_obs_sample_series_feed_the_slos():
+    """The hooks append the (t, value) samples the burn-rate windows
+    read — TTFT/e2e/inter-token per request, outcome 0.0 for a good
+    ending and 1.0 for a shed — and SLOSet.evaluate consumes the
+    ServingObs object directly."""
+    obs = ServingObs()
+    r = _req("r0", arrival=10.0)
+    obs.on_submit(r)
+    r.slot = 0
+    obs.on_admit(r, 10.5)
+    r.first_token_time = 11.0
+    obs.on_first_token(r, 11.0)
+    r.record(5, None)
+    r.record(6, None)
+    r.finish_time = 12.0
+    r.finished, r.finish_reason = True, "length"
+    obs.on_retire(r, 12.0)
+    ts = obs.timeseries()
+    assert ts["ttft_seconds"] == [(11.0, pytest.approx(1.0))]
+    assert ts["e2e_latency_seconds"] == [(12.0, pytest.approx(2.0))]
+    assert ts["inter_token_seconds"] == [(12.0, pytest.approx(1.0))]
+    assert ts["request_outcomes"] == [(12.0, 0.0)]
+    shed = _req("r1", arrival=12.5)
+    obs.on_shed(shed, 13.0)
+    assert obs.timeseries()["request_outcomes"][-1] == (13.0, 1.0)
+    assert obs.registry.get(
+        "serving_requests_shed_total").value() == 1
+    # burn-rate evaluation straight off the obs object: 1 bad of 2
+    # outcomes -> burn 50x the 1% budget in both windows -> critical
+    rep = SLOSet().evaluate(obs, now=13.0)
+    err = [o for o in rep["objectives"] if o["name"] == "error_rate"]
+    assert err[0]["state"] == "critical"
+    # the snapshot is the offline `slo --in` format
+    snap = obs.series_snapshot(now=13.0)
+    assert snap["version"] == 1 and snap["now"] == 13.0
+    assert snap["series"]["ttft_seconds"] == [[11.0, 1.0]]
+    # reset clears every surface
+    obs.reset()
+    assert all(not v for v in obs.timeseries().values())
+    assert obs.registry.get("serving_requests_shed_total").value() == 0
+
+
+def test_registry_and_histogram_reset():
+    """ISSUE 6 satellite: the explicit bench-warmup reset — series
+    cleared, instruments (identity + buckets) kept."""
+    r = MetricsRegistry()
+    c = r.counter("c")
+    c.inc(3, route="x")
+    g = r.gauge("g")
+    g.set(2)
+    h = r.histogram("h", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(9.0)
+    h.reset()
+    assert h.count() == 0 and h.sum() == 0.0
+    assert h.bucket_counts() == [0, 0, 0]
+    r.reset()
+    assert c.value(route="x") == 0.0 and g.value() == 0.0
+    assert r.counter("c") is c  # still registered, same instrument
+    h.observe(1.5)
+    assert h.count() == 1 and h.buckets == (1.0, 2.0)
+
+
+# ------------------------------------------------- flight recorder
+def test_flight_journal_lifecycle_and_anomaly_capture(tmp_path):
+    fr = FlightRecorder(ttft_threshold=0.5, e2e_threshold=2.0)
+    ok = _req("a")
+    fr.on_submit(ok, 0.0)
+    ok.slot = 0
+    fr.on_admit(ok, 0.1, queue_wait=0.1, blocks_reserved=2,
+                pool_free_blocks=6, pool_blocks_in_use=2)
+    fr.on_prefill_chunk(ok, 0.2, 3, 3)
+    fr.on_first_token(ok, 0.3, 0.3)
+    fr.on_quantum_tokens(ok, 0.5, 2)
+    ok.tokens = [1, 2]
+    fr.on_retire(ok, 0.6, ttft=0.3, e2e=0.6, reason="length")
+    # under both thresholds: journal released, nothing captured
+    assert fr.anomalies == [] and fr.live_count == 0
+    assert fr.retired_total == 1 and fr.captured_total == 0
+
+    bad = _req("b")
+    fr.on_submit(bad, 0.0)
+    bad.slot = 1
+    fr.on_admit(bad, 0.1)
+    fr.on_prefill_chunk(bad, 0.8, 3, 3)
+    fr.on_first_token(bad, 0.9, 0.9)
+    fr.on_spec_round(bad, 2.5, proposed=4, accepted=3, emitted=4)
+    bad.tokens = [1, 2, 3, 4]
+    fr.on_retire(bad, 3.0, ttft=0.9, e2e=3.0, reason="length")
+    recs = fr.records()  # schema-validates
+    assert len(recs) == 1 and recs[0]["req_id"] == "b"
+    assert set(recs[0]["anomaly"]["signals"]) == {
+        "ttft_seconds", "e2e_latency_seconds"}
+    sig = recs[0]["anomaly"]["signals"]["ttft_seconds"]
+    assert sig["value"] == pytest.approx(0.9)
+    assert sig["threshold"] == pytest.approx(0.5)
+    assert [e["kind"] for e in recs[0]["events"]] == [
+        "submit", "admit", "prefill_chunk", "first_token",
+        "spec_round", "retire"]
+    assert recs[0]["events"][4]["accepted"] == 3
+    # JSONL round-trip through disk
+    path = str(tmp_path / "anomalies.jsonl")
+    fr.save(path)
+    assert load_flight_records(path) == recs
+
+
+def test_flight_bounded_buffers_count_drops():
+    fr = FlightRecorder(e2e_threshold=0.0, max_live=2, max_events=3,
+                        max_anomalies=1)
+    a, b, c = _req("a"), _req("b"), _req("c")
+    fr.on_submit(a, 0.0)
+    fr.on_submit(b, 0.0)
+    fr.on_submit(c, 0.0)  # live table full -> rides unjournaled
+    assert fr.live_count == 2 and fr.dropped_requests == 1
+    for r in (a, b):
+        r.slot = 0
+        fr.on_admit(r, 0.1)
+        fr.on_prefill_chunk(r, 0.2, 3, 3)      # journal now full (3)
+        fr.on_first_token(r, 0.3, 0.3)         # dropped, counted
+        fr.on_quantum_tokens(r, 0.4, 1)        # dropped, counted
+    fr.on_retire(a, 1.0, ttft=0.3, e2e=1.0, reason="length")
+    fr.on_retire(b, 1.0, ttft=0.3, e2e=1.0, reason="length")
+    fr.on_retire(c, 1.0, ttft=0.3, e2e=1.0, reason="length")  # no-op
+    st = fr.stats()
+    assert st["anomalies"] == 1          # buffer bound
+    assert st["dropped_anomalies"] == 1  # b's capture found it full
+    assert st["captured_total"] == 2 and st["retired_total"] == 3
+    recs = fr.records()
+    # the retire event still lands (it pops the journal regardless),
+    # so the journal stays schema-valid: submit ... retire with the
+    # mid-flight overflow counted
+    assert recs[0]["dropped_events"] == 2
+    assert recs[0]["events"][-1]["kind"] == "retire"
+
+
+def test_flight_thresholds_come_from_slo_set():
+    fr = FlightRecorder(slo=SLOSet())
+    assert fr.ttft_threshold == 0.5 and fr.e2e_threshold == 30.0
+    # explicit override wins
+    assert FlightRecorder(slo=SLOSet(),
+                          ttft_threshold=9.9).ttft_threshold == 9.9
+    # no SLO, no overrides: nothing ever triggers
+    fr = FlightRecorder()
+    r = _req("a")
+    fr.on_submit(r, 0.0)
+    fr.on_retire(r, 1e9, ttft=1e8, e2e=1e9, reason="length")
+    assert fr.records() == []
+
+
+def test_flight_shed_always_captures():
+    fr = FlightRecorder()  # even with no thresholds: shedding IS an
+    r = _req("s")          # anomaly
+    fr.on_submit(r, 0.0)
+    fr.on_shed(r, 0.1, reason="pool_pressure")
+    recs = fr.records()
+    assert [e["kind"] for e in recs[0]["events"]] == ["submit", "shed"]
+    assert "shed" in recs[0]["anomaly"]["signals"]
+    assert recs[0]["anomaly"]["reason"] == "pool_pressure"
+
+
+def test_validate_flight_records_is_loud():
+    good = {
+        "req_id": "a", "prompt_len": 3, "max_new_tokens": 4,
+        "dropped_events": 0,
+        "anomaly": {"t": 1.0, "reason": "length", "tokens": 2,
+                    "signals": {"ttft_seconds":
+                                {"value": 1.0, "threshold": 0.5}}},
+        "events": [{"t": 0.0, "kind": "submit"},
+                   {"t": 1.0, "kind": "retire"}],
+    }
+    validate_flight_records([good])
+    for mutate, msg in (
+            (lambda r: r.pop("anomaly"), "missing 'anomaly'"),
+            (lambda r: r["anomaly"].update(signals={}),
+             "non-empty dict"),
+            (lambda r: r["events"].__setitem__(
+                0, {"t": 0.0, "kind": "warp"}), "kind must be"),
+            (lambda r: r["events"].reverse(), "time-ordered"),
+            (lambda r: r["events"].pop(), "end at retire"),
+            (lambda r: r.update(dropped_events=-1), "non-negative"),
+    ):
+        rec = json.loads(json.dumps(good))
+        mutate(rec)
+        with pytest.raises(ValueError, match=msg):
+            validate_flight_records([rec])
+
+
+# ------------------------------------------------- exporter e2e
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:       # 4xx/5xx still carry a
+        return e.code, e.read().decode()      # body we assert on
+
+
+def test_exporter_scrape_and_healthz_threshold_sides():
+    """e2e over localhost on an ephemeral port: /metrics text parses
+    back byte-identical via prometheus_from_snapshot, /healthz flips
+    200 ok -> 503 critical as the SAME objective crosses its
+    threshold, /slo carries the full burn-rate report, /anomalies
+    streams the flight dumps, unknown routes 404."""
+    registry = MetricsRegistry()
+    registry.counter("serving_requests_finished_total",
+                     "requests retired").inc(2)
+    registry.histogram("serving_ttft_seconds",
+                       buckets=(0.01, 0.1)).observe(0.05)
+    slos = SLOSet([SLO("ttft_p95", "ttft_seconds", threshold=0.1,
+                       target=0.9, warn_burn=2.0, critical_burn=8.0)])
+    now = time.perf_counter()
+    good = {"ttft_seconds": [(now, 0.01)] * 8}
+    bad = {"ttft_seconds": [(now, 5.0)] * 8}
+    flight = FlightRecorder(e2e_threshold=0.0)
+    r = _req("slow")
+    flight.on_submit(r, 0.0)
+    flight.on_retire(r, 1.0, ttft=0.5, e2e=1.0, reason="length")
+
+    exporter = MetricsExporter(registry, slos=slos, obs=good,
+                               flight=flight).start()
+    try:
+        assert exporter.port != 0  # ephemeral port resolved
+        status, prom = _get(exporter.url("/metrics"))
+        assert status == 200
+        assert prom == registry.prometheus() \
+            == prometheus_from_snapshot(registry.snapshot())
+        assert "serving_ttft_seconds_bucket" in prom
+
+        status, body = _get(exporter.url("/healthz"))
+        assert status == 200
+        assert json.loads(body) == {
+            "state": "ok", "objectives": {"ttft_p95": "ok"}}
+
+        status, body = _get(exporter.url("/snapshot"))
+        assert status == 200 and json.loads(body) == registry.snapshot()
+
+        status, body = _get(exporter.url("/slo"))
+        report = json.loads(body)
+        assert status == 200 and report["state"] == "ok"
+        assert report["objectives"][0]["windows"]["fast"]["n"] == 8
+
+        status, body = _get(exporter.url("/anomalies"))
+        assert status == 200
+        recs = [json.loads(ln) for ln in body.splitlines()]
+        assert validate_flight_records(recs)[0]["req_id"] == "slow"
+
+        status, body = _get(exporter.url("/nope"))
+        assert status == 404 and "/healthz" in body
+
+        # the other side of the threshold: same objective, now
+        # burning >= critical in both windows -> 503 + critical
+        exporter.obs = bad
+        status, body = _get(exporter.url("/healthz"))
+        assert status == 503
+        assert json.loads(body) == {
+            "state": "critical", "objectives": {"ttft_p95": "critical"}}
+    finally:
+        exporter.stop()
+    with pytest.raises(Exception):  # really stopped
+        urllib.request.urlopen(exporter.url("/metrics"), timeout=1)
+
+
+def test_exporter_without_slos_or_flight():
+    exporter = MetricsExporter(MetricsRegistry()).start()
+    try:
+        status, body = _get(exporter.url("/healthz"))
+        assert status == 200 and json.loads(body)["state"] == "ok"
+        status, _ = _get(exporter.url("/anomalies"))
+        assert status == 404
+    finally:
+        exporter.stop()
+
+
+def test_render_dashboard_frame():
+    registry = MetricsRegistry()
+    registry.counter("serving_requests_submitted_total").inc(5)
+    registry.counter("serving_requests_finished_total").inc(4)
+    registry.counter("serving_tokens_emitted_total").inc(37)
+    registry.gauge("serving_tokens_per_second_window").set(123.4)
+    registry.gauge("serving_pool_blocks_in_use").set(6, pool="target")
+    registry.gauge("serving_pool_free_blocks").set(2, pool="target")
+    registry.gauge("serving_pool_utilization").set(0.75, pool="target")
+    h = registry.histogram("serving_ttft_seconds", buckets=(0.01, 0.1))
+    h.observe(0.05)
+    now = time.perf_counter()
+    report = SLOSet().evaluate({"ttft_seconds": [(now, 0.01)]}, now=now)
+    text = render_dashboard(registry.snapshot(), report)
+    assert "[OK] ok" in text
+    assert "ttft_p95" in text and "burn fast" in text
+    assert "submitted       5" in text
+    assert "123.4 tok/s" in text
+    assert "pool[target]" in text and "util  75.0%" in text
+    # renders without a report too (watch --in with no --slo-in)
+    assert "health: [?] n/a" in render_dashboard(registry.snapshot())
+
+
+# ------------------------------------------------- engine integration
+@pytest.fixture(scope="module")
+def slo_engine():
+    """ONE tiny engine run shared by the engine-tier tests (the
+    quantum compile is the expensive part): SLOs attached, flight
+    recorder with an impossible TTFT trigger so EVERY request is a
+    threshold-crossing anomaly."""
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=4, decode_quantum=3, slo=True,
+                           flight=FlightRecorder(ttft_threshold=1e-9))
+    rng = np.random.RandomState(3)
+    for n, mn in ((5, 4), (7, 3), (3, 5)):
+        engine.submit(rng.randint(1, cfg.vocab_size, n)
+                      .astype(np.int32), max_new_tokens=mn)
+    done = engine.run()
+    return engine, done
+
+
+def test_engine_health_both_sides_of_threshold(slo_engine):
+    """engine.health() produces the stock report, and explicit
+    lenient/impossible objective sets over the SAME run read ok /
+    critical — synthetic traffic on both sides of an SLO threshold."""
+    engine, done = slo_engine
+    rep = engine.health()
+    assert rep["state"] in ("ok", "warn", "critical")
+    assert {o["name"] for o in rep["objectives"]} == {
+        "ttft_p95", "inter_token_p99", "e2e_p99", "error_rate"}
+    # every request produced exactly one ttft/e2e sample
+    ttft = [o for o in rep["objectives"] if o["name"] == "ttft_p95"][0]
+    assert ttft["windows"]["fast"]["n"] == len(done)
+    lenient = SLOSet(default_serving_slos(
+        ttft_p95_s=1e9, inter_token_p99_s=1e9, e2e_p99_s=1e9))
+    tight = SLOSet(default_serving_slos(
+        ttft_p95_s=1e-9, inter_token_p99_s=1e-9, e2e_p99_s=1e-9))
+    assert lenient.evaluate(engine.obs)["state"] == "ok"
+    assert tight.evaluate(engine.obs)["state"] == "critical"
+    # an engine without slo= refuses loudly
+    with pytest.raises(ValueError, match="without slo="):
+        from paddle_tpu.serving import ServingEngine
+
+        ServingEngine.health(
+            type("E", (), {"slo": None})())
+
+
+def test_engine_anomaly_dump_full_lifecycle(slo_engine, tmp_path):
+    """Every request crossed the forced TTFT trigger: each dump is a
+    schema-valid journal carrying the FULL lifecycle, in order, with
+    pool/block context on the admit event."""
+    engine, done = slo_engine
+    recs = engine.flight.records()  # schema-validates
+    assert len(recs) == len(done)
+    assert {r["req_id"] for r in recs} == {q.req_id for q in done}
+    for rec, req in zip(
+            sorted(recs, key=lambda r: r["req_id"]),
+            sorted(done, key=lambda q: str(q.req_id))):
+        kinds = [e["kind"] for e in rec["events"]]
+        assert kinds[0] == "submit" and kinds[-1] == "retire"
+        assert "admit" in kinds and "first_token" in kinds
+        assert "prefill_chunk" in kinds
+        admit = rec["events"][kinds.index("admit")]
+        assert admit["pool_free_blocks"] is not None
+        assert admit["queue_wait_s"] >= 0
+        assert "ttft_seconds" in rec["anomaly"]["signals"]
+        retire = rec["events"][-1]
+        assert retire["tokens"] == len(req.tokens)
+        # decode tokens are journaled (quantum yields and/or the
+        # mixed-step rows); prompt never is
+        assert rec["prompt_len"] == req.prompt_len
+    path = str(tmp_path / "dump.jsonl")
+    engine.flight.save(path)
+    assert load_flight_records(path) == recs
+    assert engine.flight.stats()["live"] == 0
+
+
+def test_engine_exporter_serves_live_state(slo_engine):
+    """MetricsExporter.for_engine wires every surface: the /healthz
+    status code agrees with the /slo state, /metrics carries the
+    engine's real histograms, /anomalies the real dumps."""
+    engine, done = slo_engine
+    exporter = MetricsExporter.for_engine(engine).start()
+    try:
+        status, prom = _get(exporter.url("/metrics"))
+        assert status == 200
+        assert f"serving_ttft_seconds_count {len(done)}" in prom
+        status, body = _get(exporter.url("/slo"))
+        state = json.loads(body)["state"]
+        hz_status, hz_body = _get(exporter.url("/healthz"))
+        assert json.loads(hz_body)["state"] == state
+        assert hz_status == (503 if state == "critical" else 200)
+        status, body = _get(exporter.url("/anomalies"))
+        assert len(body.splitlines()) == len(done)
+    finally:
+        exporter.stop()
+
+
+# ------------------------------------------------- offline CLI paths
+def test_slo_cli_offline_snapshot(tmp_path, capsys):
+    """`slo --in` evaluates a saved series snapshot without an engine
+    (tier-1-cheap), and --fail-on turns the state into an exit code."""
+    from paddle_tpu.obs.__main__ import main
+
+    now = 500.0
+    snap = {"version": 1, "now": now,
+            "series": {"ttft_seconds": [[now - 1.0, 0.001]] * 4,
+                       "request_outcomes": [[now - 1.0, 0.0]] * 4}}
+    path = str(tmp_path / "series.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    assert main(["slo", "--in", path]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["state"] == "ok" and len(rep["objectives"]) == 4
+    assert main(["slo", "--in", path, "--fail-on", "warn"]) == 0
+    # flip the traffic to the bad side: critical + fail-on trips
+    snap["series"]["ttft_seconds"] = [[now - 1.0, 9.0]] * 4
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    assert main(["slo", "--in", path, "--fail-on", "critical"]) == 1
+    capsys.readouterr()
+    # not a series snapshot -> exit 2, not a stack trace
+    with open(path, "w") as f:
+        json.dump({"version": 1}, f)
+    assert main(["slo", "--in", path]) == 2
+    assert main(["slo"]) == 2
+
+
+def test_watch_cli_offline_frame(tmp_path, capsys):
+    from paddle_tpu.obs.__main__ import main
+
+    registry = MetricsRegistry()
+    registry.counter("serving_requests_submitted_total").inc(3)
+    mpath = str(tmp_path / "metrics.json")
+    with open(mpath, "w") as f:
+        f.write(registry.snapshot_json())
+    report = SLOSet().evaluate({}, now=1.0)
+    rpath = str(tmp_path / "slo.json")
+    with open(rpath, "w") as f:
+        json.dump(report, f)
+    assert main(["watch", "--in", mpath, "--slo-in", rpath]) == 0
+    out = capsys.readouterr().out
+    assert "serving health" in out and "[OK] ok" in out
+    assert out.count("submitted") == 1  # exactly one frame
+    assert main(["watch"]) == 2
