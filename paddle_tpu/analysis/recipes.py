@@ -24,6 +24,15 @@ known-good graph shape.
   with the engine's live post-prefill state. Budget: same caps as the
   plain quantum, with BOTH the draft and target KV pool leaves
   donated.
+- ``serving_frontdoor_step``: the FRONT DOOR's quantum variant
+  (``per_request_sampling=True`` — per-slot temperature rides the
+  per-slot state as one extra (S,) f32 input; sampling selection
+  in-graph), built through an engine carrying the whole policy tier
+  (priorities, a forced preemption, SLOs, flight recorder, full
+  instrumentation). Budget: the same zero-host-callback /
+  pools-donated caps — the machine proof that streaming, preemption,
+  shedding and drain are ALL host-side policy that never enters the
+  compiled program.
 
 ``build(name)`` constructs the recipe (installing the mesh it needs)
 and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
@@ -242,11 +251,67 @@ def _build_speculative_verify_step():
     return recipe
 
 
+def _build_serving_frontdoor_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import (
+        BATCH, INTERACTIVE, FrontDoorPolicy, ServingEngine,
+        ServingFrontDoor,
+    )
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    # the front-door engine: per-request sampling (the quantum variant
+    # whose per-slot temperature input this recipe's golden pins) with
+    # the FULL policy + observability tier on — and a forced
+    # preemption before the audit, so the audited state is one a real
+    # overloaded front door reaches (evict, resume, re-prefill)
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=4,
+                           decode_strategy="sampling", top_k=8,
+                           per_request_sampling=True,
+                           trace=True, slo=True, flight=True)
+    door = ServingFrontDoor(engine, policy=FrontDoorPolicy())
+    rng = np.random.RandomState(0)
+    low = door.submit(rng.randint(1, cfg.vocab_size, 6)
+                      .astype(np.int32), max_new_tokens=8,
+                      priority=BATCH, temperature=1.3)
+    door.pump()  # admit + prefill the batch request
+    engine.preempt(low.request)  # pool-pressure eviction, host-side
+    door.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
+                max_new_tokens=8, priority=INTERACTIVE,
+                temperature=0.7)
+    door.pump()  # interactive admits; batch resumes into slot 2
+    door.pump()  # re-prefill completes; audited state is live
+    target, args = engine.decode_step_target()
+    budget = Budget(
+        name="front-door sampling quantum (bf16, single chip)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip serving program
+        max_f32_matmuls=0,        # bf16 pool/params stay bf16
+        max_host_callbacks=0,     # ALL front-door policy is host-side
+        require_donated=True,     # the 2L KV pool leaves
+        # audited 208 KB temp / 891 KB trace peak — the sampling filter
+        # (top-k cut + per-slot temperature scale) fuses into the
+        # greedy quantum's existing (S, V) temporaries; caps leave
+        # ~30% headroom like the other serving recipes
+        max_temp_bytes=280_000,
+        max_peak_live_bytes=1_300_000,
+    )
+    recipe = Recipe("serving_frontdoor_step", target, args, budget)
+    recipe.engine = engine  # obs CLI asserts the instrumented engine
+    recipe.frontdoor = door
+    return recipe
+
+
 RECIPES = {
     "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
     "llama_decode_greedy": _build_llama_decode_greedy,
     "serving_decode_step": _build_serving_decode_step,
     "speculative_verify_step": _build_speculative_verify_step,
+    "serving_frontdoor_step": _build_serving_frontdoor_step,
 }
 
 

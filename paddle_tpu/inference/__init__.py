@@ -147,30 +147,76 @@ def create_serving_engine(model, **kwargs):
     """Continuous-batching entry point next to ``create_predictor``:
     wrap a causal LM in a :class:`~paddle_tpu.serving.ServingEngine`
     (shared paged KV pool, chunked prefill, single-dispatch decode
-    quantum). Keyword args forward to the engine — num_slots,
-    block_size, decode_quantum, decode_strategy, eos_token_id, ...;
-    pass ``spec_draft=<draft LM>`` (and ``spec_gamma``) to switch the
+    quantum). This is the LIBRARY LOOP — for the serving *system*
+    (streaming, priorities, shedding, drain) use :func:`serve`, which
+    wraps this engine in the front door.
+
+    Keyword args forward to the engine — num_slots, block_size,
+    decode_quantum, decode_strategy, eos_token_id, ...; pass
+    ``spec_draft=<draft LM>`` (and ``spec_gamma``) to switch the
     quantum to the one-dispatch SPECULATIVE drafter/verifier round,
-    and ``trace=True`` (or ``obs=<ServingObs>``) for the runtime
-    observability layer — metrics registry + Chrome-trace request
-    spans via :mod:`paddle_tpu.obs`, all recorded at host scheduler
-    boundaries (the jitted quantum's fingerprint is unchanged).
-    The operability tier rides the same boundaries: ``slo=True`` (or
-    an :class:`~paddle_tpu.obs.slo.SLOSet` / list of
+    ``per_request_sampling=True`` (with
+    ``decode_strategy="sampling"``) for the front-door quantum variant
+    whose per-slot temperature input carries each request's
+    ``temperature``, and ``trace=True`` (or ``obs=<ServingObs>``) for
+    the runtime observability layer — metrics registry + Chrome-trace
+    request spans via :mod:`paddle_tpu.obs`, all recorded at host
+    scheduler boundaries (the jitted quantum's fingerprint is
+    unchanged). The operability tier rides the same boundaries:
+    ``slo=True`` (or an :class:`~paddle_tpu.obs.slo.SLOSet` / list of
     :class:`~paddle_tpu.obs.slo.SLO`) attaches serving objectives —
     ``engine.health()`` evaluates them with multi-window burn rates,
     and :class:`~paddle_tpu.obs.export.MetricsExporter` serves the
     report live over ``/metrics`` / ``/healthz`` / ``/slo`` — and
     ``flight=True`` (or a
     :class:`~paddle_tpu.obs.flight.FlightRecorder`) journals every
-    request's lifecycle, dumping the journal on SLO-threshold
-    crossings. See :mod:`paddle_tpu.serving`."""
+    request's lifecycle (including preempt/resume events), dumping the
+    journal on SLO-threshold crossings. Per-request knobs ride
+    ``engine.submit`` — priority, temperature, stop_token_ids,
+    stop_sequences, max_new_tokens, seed. See
+    :mod:`paddle_tpu.serving`."""
     from ..serving import ServingEngine
 
     return ServingEngine(model, **kwargs)
 
 
-__all__.append("create_serving_engine")
+def serve(model, policy=None, slo=True, flight=True, **kwargs):
+    """The production front door (reference: the deployed serving
+    system around AnalysisPredictor / ``Predictor.run`` — PAPER.md
+    §2.6/§3.5): build a :class:`~paddle_tpu.serving.ServingEngine` and
+    wrap it in a :class:`~paddle_tpu.serving.ServingFrontDoor` —
+    token-by-token streaming (sync or ``async for`` under
+    ``run_async()``), per-request generation params, priority classes
+    with pool-pressure preemption (recompute-on-resume, bit-exact
+    continuation), SLO-burn-rate load shedding + queue backpressure
+    (``policy=`` a :class:`~paddle_tpu.serving.FrontDoorPolicy`), and
+    graceful ``drain()``.
+
+    ``slo`` / ``flight`` default ON (shedding needs the health report;
+    drain flushes the journals); ``decode_strategy="sampling"``
+    auto-enables ``per_request_sampling`` so ``submit(...,
+    temperature=)`` works per request. Remaining keyword args forward
+    to the engine (:func:`create_serving_engine` documents them).
+
+    ::
+
+        fd = paddle.inference.serve(model, num_slots=8,
+                                    eos_token_id=2)
+        stream = fd.submit(prompt, priority=serving.INTERACTIVE,
+                           max_new_tokens=128)
+        for tok in stream:          # pumps the engine as it pulls
+            ...
+        fd.drain("flight.jsonl")    # stop admitting, finish, flush
+    """
+    from ..serving import ServingEngine, ServingFrontDoor
+
+    if kwargs.get("decode_strategy") == "sampling":
+        kwargs.setdefault("per_request_sampling", True)
+    engine = ServingEngine(model, slo=slo, flight=flight, **kwargs)
+    return ServingFrontDoor(engine, policy=policy)
+
+
+__all__ += ["create_serving_engine", "serve"]
 
 
 def __getattr__(name):

@@ -487,7 +487,13 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=32,
     distribution-exact rejection sampling (row i seeds with
     ``seed + i``), deterministic given seeds. This is the serving-grade
     path that replaces the host-driven ``speculative_greedy_search``
-    (kept below as the reference/bench baseline it beat).
+    (kept below as the reference/bench baseline it beat). For an
+    operated service around this loop — streaming, priorities with
+    preemption, SLO load shedding, drain — front the engine with
+    ``paddle.inference.serve()`` instead of calling this batch facade
+    (a speculative engine composes with the front door's priority /
+    preemption / shedding tier; per-request temperature needs the
+    plain quantum for now).
 
     Returns ``(tokens, acceptance_rate)``: (B, S_in+max_new) ids (rows
     finishing early at ``eos_token_id`` pad the tail with it) and the

@@ -40,6 +40,7 @@ class PagedKVCachePool:
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id -> list[int] block ids
         self._lens: dict = {}     # seq_id -> int tokens
+        self._refcounts: dict = {}  # block id -> holders (>= 1 while out)
         self._peak_blocks = 0     # high-water mark of blocks_in_use
         self._freed_total = 0     # blocks returned over the pool's life
 
@@ -52,18 +53,53 @@ class PagedKVCachePool:
             if not self._free:
                 raise RuntimeError(
                     f"KV pool exhausted ({self.num_blocks} blocks)")
-            table.append(self._free.pop())
+            blk = self._free.pop()
+            self._refcounts[blk] = 1
+            table.append(blk)
         self._lens[seq_id] = int(new_total_tokens)
         self._peak_blocks = max(self._peak_blocks, self.blocks_in_use)
         return table
 
+    def share(self, src_seq_id, dst_seq_id):
+        """Alias ``src``'s blocks into a new table for ``dst`` with the
+        refcounts bumped — the content-reuse primitive (prefix cache /
+        copy-on-write): each shared block only returns to the free list
+        when its LAST holder releases it, so eviction of one holder can
+        never free a block another sequence still maps."""
+        if dst_seq_id in self._tables:
+            raise ValueError(f"sequence {dst_seq_id!r} already exists")
+        src = self._tables.get(src_seq_id)
+        if src is None:
+            raise KeyError(f"unknown sequence {src_seq_id!r}")
+        for blk in src:
+            self._refcounts[blk] += 1
+        self._tables[dst_seq_id] = list(src)
+        self._lens[dst_seq_id] = self._lens.get(src_seq_id, 0)
+        return self._tables[dst_seq_id]
+
+    def _release(self, blocks):
+        """Refcount-safe return path shared by free/trim: decrement each
+        block's holder count and only hand it back to the free list at
+        zero. Double-release of a block this pool no longer tracks is a
+        hard error (the eviction-leak class the serving tests pin)."""
+        for blk in blocks:
+            n = self._refcounts.get(blk)
+            if n is None:
+                raise RuntimeError(
+                    f"block {blk} released but not held — double free")
+            if n > 1:
+                self._refcounts[blk] = n - 1
+            else:
+                del self._refcounts[blk]
+                self._free.append(blk)
+                self._freed_total += 1
+
     def free(self, seq_id):
-        """Return a finished sequence's blocks to the pool (immediate
-        reuse: the free list is LIFO, so a retiring sequence's blocks go
-        straight to the next admission)."""
+        """Release a finished (or evicted) sequence's hold on its
+        blocks; fully-released blocks return to the pool for immediate
+        reuse (LIFO free list — straight to the next admission)."""
         blocks = self._tables.pop(seq_id, [])
-        self._free.extend(blocks)
-        self._freed_total += len(blocks)
+        self._release(blocks)
         self._lens.pop(seq_id, None)
 
     def trim(self, seq_id, new_total_tokens):
@@ -77,8 +113,7 @@ class PagedKVCachePool:
         keep = -(-int(new_total_tokens) // self.block_size)
         released = table[keep:]
         del table[keep:]
-        self._free.extend(released)
-        self._freed_total += len(released)
+        self._release(released)
         self._lens[seq_id] = min(self._lens.get(seq_id, 0),
                                  int(new_total_tokens))
         return released

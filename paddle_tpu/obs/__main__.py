@@ -29,14 +29,18 @@ terminal dashboard — polling a running exporter's ``/snapshot`` +
 ``/slo`` with ``--url``, or one frame from saved files with ``--in``.
 
 ``check`` is the instrumentation-can't-change-the-graph gate used by
-``scripts/check_graphs.sh``: it builds the serving + speculative
-analysis recipes — whose engines run with FULL observability (registry
-+ tracer + SLOs + flight recorder) — re-checks their budgets, compares
-the golden fingerprints, and asserts the instrumentation actually
-recorded (metrics counted, trace validates). It then runs the SLO
-smoke on the demo engine: lenient objectives must read ``ok``,
-impossible ones ``critical``, and forced threshold crossings must
-produce schema-valid anomaly journals. Exit non-zero on drift.
+``scripts/check_graphs.sh``: it builds the serving + speculative +
+front-door analysis recipes — whose engines run with FULL
+observability (registry + tracer + SLOs + flight recorder) — re-checks
+their budgets, compares the golden fingerprints, and asserts the
+instrumentation actually recorded (metrics counted, trace validates).
+It then runs the SLO smoke on the demo engine (lenient objectives must
+read ``ok``, impossible ones ``critical``, forced threshold crossings
+must produce schema-valid anomaly journals) and the FRONT-DOOR smoke
+(ISSUE 7: a forced priority preemption must fire the
+preempted/resumed/recomputed counters, resume bit-continuously, drain
+must flush the flight journals, and the dashboard must render the
+overload line). Exit non-zero on drift.
 """
 from __future__ import annotations
 
@@ -230,7 +234,8 @@ def _cmd_watch(args):
     return 0
 
 
-_CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step")
+_CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step",
+                  "serving_frontdoor_step")
 
 
 def _check_slo_smoke():
@@ -267,6 +272,66 @@ def _check_slo_smoke():
     print(f"slo smoke: lenient=ok impossible=critical "
           f"stock={report['state']}, {len(records)} schema-valid "
           f"anomaly journals for {finished} requests")
+
+
+def _check_frontdoor_smoke():
+    """The front-door smoke (ISSUE 7): drive a one-slot engine through
+    a FORCED preemption — a BATCH request mid-decode evicted by an
+    INTERACTIVE arrival — then assert the overload counters fired
+    (preempted/resumed/recomputed + a drain), the resumed stream is
+    the right length, the pool fully reclaimed its blocks, and the
+    dashboard frame renders the overload line from a live snapshot."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        BATCH, INTERACTIVE, FrontDoorPolicy, ServingEngine,
+        ServingFrontDoor,
+    )
+    from .export import render_dashboard
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    engine = ServingEngine(model, num_slots=1, block_size=4,
+                           prefill_chunk=4, decode_quantum=2,
+                           slo=True, flight=True)
+    door = ServingFrontDoor(engine, policy=FrontDoorPolicy())
+    rng = np.random.RandomState(0)
+    low = door.submit(rng.randint(1, cfg.vocab_size, 5)
+                      .astype(np.int32), max_new_tokens=6,
+                      priority=BATCH)
+    while len(low.request.tokens) < 2:  # batch request mid-decode
+        door.pump()
+    hi = door.submit(rng.randint(1, cfg.vocab_size, 4)
+                     .astype(np.int32), max_new_tokens=4,
+                     priority=INTERACTIVE)
+    summary = door.drain()  # finish everything, flush the recorder
+    reg = engine.obs.registry
+    if reg.get("serving_requests_preempted_total").value() < 1 \
+            or reg.get("serving_requests_resumed_total").value() < 1:
+        raise AssertionError(
+            "forced preemption did not fire: "
+            f"{summary}")
+    if reg.get("serving_tokens_recomputed_total").value() < 1:
+        raise AssertionError("preemption recorded no recompute debt")
+    if reg.get("serving_drains_total").value() != 1:
+        raise AssertionError("drain counter did not fire")
+    if len(hi.request.tokens) != 4 or len(low.request.tokens) != 6:
+        raise AssertionError(
+            f"streams wrong after preempt/resume: hi="
+            f"{len(hi.request.tokens)} low={len(low.request.tokens)}")
+    if engine.pool.fragmentation_stats()["blocks_in_use"] != 1:
+        raise AssertionError("pool leaked blocks across preemption")
+    frame = render_dashboard(reg.snapshot(), engine.health())
+    if "preempted" not in frame or "shed" not in frame:
+        raise AssertionError("dashboard frame missing overload line")
+    print(f"front-door smoke: preempted="
+          f"{engine.scheduler.preempted_total} resumed="
+          f"{engine.scheduler.resumed_total} recomputed="
+          f"{int(reg.get('serving_tokens_recomputed_total').value())} "
+          f"tokens, drain flushed "
+          f"{summary['flight']['captured_total']} journals")
 
 
 def _cmd_check(args):
@@ -311,6 +376,11 @@ def _cmd_check(args):
     except (AssertionError, ValueError) as e:
         failed = True
         print(f"slo smoke: FAIL — {e}", file=sys.stderr)
+    try:
+        _check_frontdoor_smoke()
+    except (AssertionError, ValueError) as e:
+        failed = True
+        print(f"front-door smoke: FAIL — {e}", file=sys.stderr)
     if failed:
         return 1
     print("obs check: instrumentation-enabled fingerprints unchanged")

@@ -280,6 +280,16 @@ def render_dashboard(snapshot, report=None, width=62):
         f"  rate "
         f"{g('serving_tokens_per_second_window'):>10.1f} tok/s")
     lines.append(
+        f" overload  shed {g('serving_requests_shed_total'):>6.0f}"
+        f"  preempted {g('serving_requests_preempted_total'):>5.0f}"
+        f"  resumed {g('serving_requests_resumed_total'):>5.0f}"
+        f"  drains {g('serving_drains_total'):>3.0f}")
+    recomputed = g("serving_tokens_recomputed_total")
+    if recomputed:
+        lines.append(
+            f" recompute {recomputed:>6.0f} cached tokens dropped by "
+            f"preemption (re-prefilled on resume)")
+    lines.append(
         f" latency   ttft p50 {_fmt_s(_snap_quantile(snapshot, 'serving_ttft_seconds', 0.5))}"
         f"  p95 {_fmt_s(_snap_quantile(snapshot, 'serving_ttft_seconds', 0.95))}"
         f"   e2e p95 {_fmt_s(_snap_quantile(snapshot, 'serving_e2e_latency_seconds', 0.95))}")

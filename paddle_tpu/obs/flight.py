@@ -1,7 +1,8 @@
 """Per-request flight recorder: a bounded journal of every lifecycle
 event a request passes through on the host scheduler — submit, admit
 (with pool/block context), prefill chunks, first token, decode-quantum
-yields, speculative rounds with acceptance, retire — with
+yields, speculative rounds with acceptance, preempt/resume (the front
+door's eviction pair, with the recompute debt), retire — with
 DUMP-ON-ANOMALY: when a retiring request's TTFT or e2e latency crosses
 its SLO threshold (obs/slo.py), the full journal is captured into a
 bounded anomaly buffer and exportable as schema-validated JSON-lines,
@@ -31,7 +32,8 @@ __all__ = ["FlightRecorder", "validate_flight_records",
            "load_flight_records", "EVENT_KINDS"]
 
 EVENT_KINDS = ("submit", "admit", "prefill_chunk", "first_token",
-               "decode_quantum", "spec_round", "shed", "retire")
+               "decode_quantum", "spec_round", "preempt", "resume",
+               "shed", "retire")
 
 _ANOMALY_SIGNALS = ("ttft_seconds", "e2e_latency_seconds")
 
@@ -137,6 +139,22 @@ class FlightRecorder:
         stream (acceptance prefix + bonus, capped by eos/max-new)."""
         self._event(req, "spec_round", t, proposed=int(proposed),
                     accepted=int(accepted), emitted=int(emitted))
+
+    def on_preempt(self, req, t, cached_tokens=0, tokens_emitted=0):
+        """The request lost its slot under pool pressure: its cached KV
+        (``cached_tokens``) went back to the pool and will be
+        re-prefilled on resume; the emitted stream is untouched."""
+        self._event(req, "preempt", t, cached_tokens=int(cached_tokens),
+                    tokens_emitted=int(tokens_emitted))
+
+    def on_resume(self, req, t, slot=None, prefill_tokens=0):
+        """The preempted request re-admitted: ``prefill_tokens`` =
+        prompt + emitted tokens to re-prefill before the stream
+        continues."""
+        self._event(req, "resume", t,
+                    slot=(None if slot is None else int(slot)),
+                    prefill_tokens=int(prefill_tokens),
+                    preemptions=int(req.preemptions))
 
     def on_shed(self, req, t, reason="shed"):
         """A request refused admission by a load-shedding policy: its

@@ -20,8 +20,11 @@ Exported serving metrics (all host-boundary):
 - counters: ``serving_requests_{submitted,admitted,finished}_total``,
   ``serving_tokens_emitted_total`` (one bump per token actually
   appended to a request — the stream-match invariant the obs tests
-  assert), plus the legacy ``serving_*_total`` counters behind
-  ``engine.stats``.
+  assert), the front door's overload counters
+  ``serving_requests_{shed,preempted,resumed}_total`` /
+  ``serving_tokens_recomputed_total`` / ``serving_drains_total``
+  (serving/frontend.py), plus the legacy ``serving_*_total`` counters
+  behind ``engine.stats``.
 - histograms: ``serving_queue_wait_seconds``, ``serving_ttft_seconds``
   (observed exactly once per request, at the prefill-completion step
   that emits its first token), ``serving_e2e_latency_seconds``,
@@ -169,6 +172,23 @@ class ServingObs:
         self._c_shed = r.counter(
             "serving_requests_shed_total",
             "requests refused by load shedding")
+        # the front door's overload counters (serving/frontend.py):
+        # preempt/resume pair up over a run, drains count graceful
+        # stop-the-front-door events, recomputed tokens are the KV a
+        # preemption dropped (re-prefilled on resume — the recompute-
+        # on-resume debt)
+        self._c_preempted = r.counter(
+            "serving_requests_preempted_total",
+            "live requests evicted under pool pressure")
+        self._c_resumed = r.counter(
+            "serving_requests_resumed_total",
+            "preempted requests re-admitted (recompute-on-resume)")
+        self._c_recomputed = r.counter(
+            "serving_tokens_recomputed_total",
+            "cached tokens dropped by preemption (re-prefilled on "
+            "resume)")
+        self._c_drains = r.counter(
+            "serving_drains_total", "graceful drains started")
         self._window = deque()
         self._cum_tokens = 0
         self._series = {
@@ -268,10 +288,10 @@ class ServingObs:
         e2e = now - req.arrival_time
         self._h_e2e.observe(e2e)
         self._series["e2e_latency_seconds"].append((now, e2e))
-        # outcome sample for the error/shed-rate SLO: eos/length are
-        # the good endings, anything else is a bad one
+        # outcome sample for the error/shed-rate SLO: eos/stop/length
+        # are the good endings, anything else is a bad one
         self._series["request_outcomes"].append(
-            (now, 0.0 if req.finish_reason in ("eos", "length")
+            (now, 0.0 if req.finish_reason in ("eos", "stop", "length")
              else 1.0))
         n = len(req.tokens)
         if req.first_token_time is not None and n >= 2:
@@ -287,9 +307,9 @@ class ServingObs:
 
     def on_shed(self, req, now):
         """A request refused admission by a load-shedding policy (the
-        SLO-driven scheduler this layer feeds): counted, and recorded
-        as a BAD outcome sample so the error/shed-rate objective burns
-        budget for it."""
+        front door's SLO-driven admission, serving/policy.py): counted,
+        and recorded as a BAD outcome sample so the error/shed-rate
+        objective burns budget for it."""
         if not self.enabled:
             return
         self._c_shed.inc()
@@ -297,6 +317,46 @@ class ServingObs:
         if self.tracer is not None:
             self.tracer.instant("shed", now, tid=0,
                                 args={"req": str(req.req_id)})
+
+    def on_preempt(self, req, now, cached_tokens=0):
+        """A live request evicted under pool pressure: its
+        ``cached_tokens`` of KV go back to the pool and become
+        recompute debt (re-prefilled when it resumes)."""
+        if not self.enabled:
+            return
+        self._c_preempted.inc()
+        self._c_recomputed.inc(int(cached_tokens))
+        if self.tracer is not None:
+            tid = 0 if req.slot is None else req.slot + 1
+            self.tracer.instant("preempt", now, tid=tid,
+                                args={"req": str(req.req_id),
+                                      "cached_tokens": int(
+                                          cached_tokens)})
+
+    def on_resume(self, req, now):
+        """A preempted request re-admitted to a slot (the resume half
+        of the preempt/resume pair; TTFT and queue-wait were observed
+        on the FIRST admission, so neither re-observes here)."""
+        if not self.enabled:
+            return
+        self._c_resumed.inc()
+        if self.tracer is not None:
+            tid = 0 if req.slot is None else req.slot + 1
+            self.tracer.instant("resume", now, tid=tid,
+                                args={"req": str(req.req_id),
+                                      "preemptions": int(
+                                          req.preemptions)})
+
+    def on_drain(self, now, live=0, waiting=0):
+        """The front door stopped admitting (graceful drain): counted;
+        in-flight work finishes and the flight recorder flushes."""
+        if not self.enabled:
+            return
+        self._c_drains.inc()
+        if self.tracer is not None:
+            self.tracer.instant("drain", now, tid=0,
+                                args={"live": int(live),
+                                      "waiting": int(waiting)})
 
     # -- step / dispatch hooks ---------------------------------------------
     def on_step(self, now, live, num_slots, pool, d_pool=None):

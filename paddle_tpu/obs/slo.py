@@ -30,8 +30,9 @@ long-ago incident (slow hot, fast cold) does not flap the state.
 States are totally ordered ``OK < WARN < CRITICAL``
 (:class:`HealthState`); an :class:`SLOSet` evaluates many objectives
 and reports the worst, as a machine-readable dict the exporter's
-``/healthz`` / ``/slo`` endpoints (obs/export.py) and a future
-load-shedding scheduler consume directly.
+``/healthz`` / ``/slo`` endpoints (obs/export.py) and the serving
+front door's load-shedding admission
+(:class:`paddle_tpu.serving.FrontDoorPolicy`) consume directly.
 
 Edge semantics, unit-tested (tests/test_slo.py): an EMPTY window burns
 nothing (no traffic is not an outage — n=0, burn 0.0, OK), and
@@ -282,7 +283,8 @@ class SLOSet:
 
     def evaluate(self, source, now=None):
         """The machine-readable health report the exporter serves and
-        a shedding scheduler would poll. ``source`` is a ServingObs (or
+        the front door's shedding admission polls. ``source`` is a
+        ServingObs (or
         anything with ``timeseries()``) or a plain series dict."""
         series = (source.timeseries() if hasattr(source, "timeseries")
                   else source)

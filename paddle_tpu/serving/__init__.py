@@ -9,16 +9,35 @@ single-dispatch jitted decode step; :mod:`.scheduler` holds the
 admission queue, slot table, and block accounting. With a
 ``spec_draft`` model the decode quantum becomes the ON-DEVICE
 speculative round of :mod:`.speculative` (draft-γ scan + one-forward
-verify + in-graph acceptance, both paged pools donated). The compiled
-programs are pinned by the ``serving_decode_step`` /
-``speculative_verify_step`` analysis Budgets (zero involuntary remat,
-zero host callbacks, KV pools donated). Benched by
-``scripts/bench_serving.py`` (ragged Poisson arrivals + speculative
-serving vs the plain quantum).
+verify + in-graph acceptance, both paged pools donated).
+
+The FRONT DOOR (:mod:`.frontend` + :mod:`.policy`, entry point
+``paddle.inference.serve()``) is the serving *system* over that loop:
+:class:`ServingFrontDoor` streams tokens per request
+(:class:`TokenStream`, sync or ``async for``), applies priority
+classes (``BATCH < NORMAL < INTERACTIVE``) with pool-pressure
+preemption (evict-and-recompute-on-resume, bit-exact continuation),
+sheds load off the SLO burn-rate health report
+(:class:`FrontDoorPolicy`), and drains gracefully.
+
+The compiled programs are pinned by the ``serving_decode_step`` /
+``speculative_verify_step`` / ``serving_frontdoor_step`` analysis
+Budgets (zero involuntary remat, zero host callbacks, KV pools
+donated). Benched by ``scripts/bench_serving.py`` (ragged Poisson
+arrivals, speculative serving vs the plain quantum, and the
+``serving_overload`` shed/no-shed burst rows).
 """
 from .scheduler import Request, Scheduler, SchedulerConfig
 from .engine import ServingEngine
 from .speculative import make_spec_round
+from .policy import (
+    BATCH, INTERACTIVE, NORMAL, FrontDoorPolicy, choose_victim,
+    no_shed_policy,
+)
+from .frontend import ServingFrontDoor, TokenStream
 
 __all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine",
-           "make_spec_round"]
+           "make_spec_round",
+           "BATCH", "NORMAL", "INTERACTIVE", "FrontDoorPolicy",
+           "choose_victim", "no_shed_policy",
+           "ServingFrontDoor", "TokenStream"]

@@ -56,6 +56,18 @@ declarative objectives evaluated with multi-window burn rates
 ``/slo``), and ``flight=`` a per-request flight recorder whose
 journals dump on SLO-threshold crossings (obs/flight.py) — so a slow
 tail request is explainable, not just a histogram bucket.
+
+The FRONT DOOR (serving/frontend.py + serving/policy.py) wraps this
+engine into the serving *system*: token-by-token streaming (the
+``token_sink`` hook below fires per emitted token), priority classes
+with :meth:`preempt` (evict a victim's blocks back to the pool,
+recompute-on-resume), SLO-burn-rate load shedding through
+``engine.health()`` and the obs ``on_shed`` hook, and graceful drain.
+Every one of those mechanisms is host-side policy at the same
+scheduler boundaries: the compiled quantum's ``max_host_callbacks=0``
+budget and golden fingerprint are unchanged (the
+``serving_frontdoor_step`` recipe pins the per-request-sampling
+variant with its own golden).
 """
 from __future__ import annotations
 
@@ -317,6 +329,13 @@ class ServingEngine:
             greedy arm emits exactly the target's greedy stream; the
             sampling arm is distribution-exact rejection sampling.
         spec_gamma: proposals per speculative round (default 4).
+        per_request_sampling: build the FRONT-DOOR quantum variant
+            (requires ``decode_strategy="sampling"``): each slot's
+            temperature rides the per-slot state as one extra (S,)
+            f32 quantum input, so ``submit(..., temperature=)`` works
+            per request. The default engine's quantum signature — and
+            its golden fingerprint — are untouched; the variant is
+            pinned by its own ``serving_frontdoor_step`` recipe.
         obs: observability sink — ``None`` builds a fresh
             :class:`~paddle_tpu.obs.serving.ServingObs` (metrics
             registry always on), ``"off"`` disables the rich hooks
@@ -354,8 +373,8 @@ class ServingEngine:
                  max_context=None, prefill_chunk=64, decode_quantum=8,
                  decode_strategy="greedy", top_k=0, top_p=1.0,
                  temperature=1.0, eos_token_id=None, spec_draft=None,
-                 spec_gamma=4, obs=None, trace=False, slo=None,
-                 flight=None):
+                 spec_gamma=4, per_request_sampling=False, obs=None,
+                 trace=False, slo=None, flight=None):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -366,6 +385,17 @@ class ServingEngine:
             raise ValueError(
                 f"decode_strategy must be greedy|sampling, got "
                 f"{decode_strategy!r}")
+        self._per_request_sampling = bool(per_request_sampling)
+        if self._per_request_sampling and decode_strategy != "sampling":
+            raise ValueError(
+                "per_request_sampling=True requires "
+                "decode_strategy='sampling' (per-slot temperature only "
+                "changes the sampling quantum)")
+        if self._per_request_sampling and spec_draft is not None:
+            raise NotImplementedError(
+                "per_request_sampling does not compose with spec_draft "
+                "yet: the speculative round's acceptance math takes the "
+                "engine-wide temperature")
         if spec_draft is not None:
             d_cfg = spec_draft.config
             if getattr(d_cfg, "sliding_window", None):
@@ -439,6 +469,14 @@ class ServingEngine:
         self._done = np.ones(s, bool)
         self._max_new = np.zeros(s, np.int32)
         self._keys = np.zeros((s, 2), np.uint32)
+        # per-slot temperature: an input of the front-door quantum
+        # variant (per_request_sampling=True); the default engine's
+        # quantum signature — and golden fingerprint — never sees it
+        self._temps = np.ones(s, np.float32)
+        # front-door streaming hook: called (req, token) for EVERY
+        # token appended to a request's stream, at the same host
+        # boundary obs.on_token fires on
+        self.token_sink = None
 
         # rotary table shared by prefill (block_mha fused rope) and the
         # quantum (per-row angles recomputed on device)
@@ -486,8 +524,9 @@ class ServingEngine:
         self._now = self.obs.now
         self.stats = self.obs.legacy_stats_view()
         # SLO + flight recorder (the operability tier over the obs
-        # boundaries): health for a future scheduler/shedder, and the
-        # journal that explains a slow tail request after the fact
+        # boundaries): health feeds the front door's shedding policy
+        # (serving/frontend.py), and the journal explains a slow tail
+        # request after the fact
         if slo is True:
             self.slo = SLOSet()
         elif slo is None or isinstance(slo, SLOSet):
@@ -503,10 +542,26 @@ class ServingEngine:
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, req_id=None, seed=0,
-               arrival_time=None):
-        """Queue one request; returns the :class:`Request` handle."""
+               arrival_time=None, priority=1, temperature=None,
+               stop_token_ids=None, stop_sequences=None):
+        """Queue one request; returns the :class:`Request` handle.
+
+        Per-request knobs: ``priority`` (admission class, see
+        serving/policy.py), ``temperature`` (needs an engine built with
+        ``per_request_sampling=True``), ``stop_token_ids`` /
+        ``stop_sequences`` (host-side stop rules; ``finish_reason``
+        becomes ``"stop"``), plus the existing ``max_new_tokens`` /
+        ``seed``."""
+        if temperature is not None and not self._per_request_sampling:
+            raise ValueError(
+                "per-request temperature needs an engine built with "
+                "per_request_sampling=True (and "
+                "decode_strategy='sampling')")
         req = Request(prompt, max_new_tokens=max_new_tokens,
-                      req_id=req_id, seed=seed,
+                      req_id=req_id, seed=seed, priority=priority,
+                      temperature=temperature,
+                      stop_token_ids=stop_token_ids,
+                      stop_sequences=stop_sequences,
                       arrival_time=(self._now()
                                     if arrival_time is None
                                     else arrival_time))
@@ -517,6 +572,30 @@ class ServingEngine:
                 f"{self.max_context}")
         self.scheduler.submit(req)
         self._on_submitted(req)
+        return req
+
+    def preempt(self, req):
+        """Evict a live request under pool pressure: its blocks return
+        to every pool (refcount-safe), its slot frees, and it re-enters
+        the head of its priority class for recompute-on-resume — the
+        next admission re-prefills ``prompt + tokens`` and the stream
+        continues bit-exact vs an undisturbed run (tests/test_serving's
+        preemption oracle). The evicted KV (``seq_lens[slot]`` cached
+        tokens) is counted as recompute debt."""
+        if req.slot is None or req.finished:
+            raise ValueError(
+                f"request {req.req_id} is not live — only an admitted, "
+                f"unfinished request can be preempted")
+        slot = req.slot
+        now = self._now()
+        cached = int(self._seq_lens[slot])
+        self._done[slot] = True
+        self._max_new[slot] = 0
+        self.scheduler.preempt(req)
+        self.obs.on_preempt(req, now, cached_tokens=cached)
+        if self.flight is not None:
+            self.flight.on_preempt(req, now, cached_tokens=cached,
+                                   tokens_emitted=len(req.tokens))
         return req
 
     def _on_submitted(self, req):
@@ -573,6 +652,8 @@ class ServingEngine:
         out["pool"] = self.pool.fragmentation_stats()
         out["admitted"] = self.scheduler.admitted_total
         out["finished"] = self.scheduler.finished_total
+        out["preempted"] = self.scheduler.preempted_total
+        out["resumed"] = self.scheduler.resumed_total
         if self.stats["steps"]:
             out["mean_occupancy"] = (self.stats["occupancy_sum"]
                                      / self.stats["steps"])
@@ -593,8 +674,9 @@ class ServingEngine:
         """Evaluate the engine's SLOs over the obs sample series: the
         multi-window burn-rate report (state ``ok``/``warn``/
         ``critical`` + per-objective windows) the exporter's
-        ``/healthz`` endpoint and a shedding scheduler consume. The
-        engine must have been built with ``slo=``."""
+        ``/healthz`` endpoint and the front door's shedding admission
+        (serving/policy.py) consume. The engine must have been built
+        with ``slo=``."""
         if self.slo is None:
             raise ValueError(
                 "engine built without slo=: pass slo=True (stock "
@@ -605,22 +687,33 @@ class ServingEngine:
     def _admit(self):
         now = self._now()
         for req in self.scheduler.try_admit():
+            resumed = req.preemptions > 0
             req.admit_time = now
-            self.obs.on_admit(req, now)
-            if self.flight is not None:
-                st = self.pool.fragmentation_stats()
-                self.flight.on_admit(
-                    req, now, queue_wait=now - req.arrival_time,
-                    blocks_reserved=self.scheduler._reservations.get(
-                        req),
-                    pool_free_blocks=st["free_blocks"],
-                    pool_blocks_in_use=st["blocks_in_use"])
+            if resumed:
+                self.obs.on_resume(req, now)
+                if self.flight is not None:
+                    self.flight.on_resume(
+                        req, now, slot=req.slot,
+                        prefill_tokens=req.prefill_target)
+            else:
+                self.obs.on_admit(req, now)
+                if self.flight is not None:
+                    st = self.pool.fragmentation_stats()
+                    self.flight.on_admit(
+                        req, now, queue_wait=now - req.arrival_time,
+                        blocks_reserved=self.scheduler._reservations.get(
+                            req),
+                        pool_free_blocks=st["free_blocks"],
+                        pool_blocks_in_use=st["blocks_in_use"])
             slot = req.slot
             self._seq_lens[slot] = 0
             self._n_gen[slot] = 0
             self._done[slot] = True  # not decodable until prefill ends
             self._max_new[slot] = req.max_new_tokens
             self._keys[slot] = np.asarray(jax.random.PRNGKey(req.seed))
+            self._temps[slot] = (self.temperature
+                                 if req.temperature is None
+                                 else req.temperature)
 
     def _mixed_forward(self, model, pool, tables, rotary, enc_lens,
                        dec_lens, this_time, ids, total):
@@ -696,8 +789,9 @@ class ServingEngine:
         spec = self.spec_draft is not None
         toks, this_time, enc_lens, dec_lens = [], [], [], []
         for req in pre:
-            n = min(chunk, req.prompt_len - req.prefill_pos)
-            toks.append(req.prompt[req.prefill_pos:req.prefill_pos + n])
+            n = min(chunk, req.prefill_target - req.prefill_pos)
+            toks.append(
+                req.prefill_src[req.prefill_pos:req.prefill_pos + n])
             this_time.append(n)
             enc_lens.append(n)
             dec_lens.append(req.prefill_pos)
@@ -735,7 +829,7 @@ class ServingEngine:
         # prefill this chunk, and every decode row
         need = [i for i, req in enumerate(rows)
                 if (i >= len(pre)) or
-                (req.prefill_pos + this_time[i] >= req.prompt_len)]
+                (req.prefill_pos + this_time[i] >= req.prefill_target)]
         if need:
             last_idx = np.asarray([cu[i + 1] - 1 for i in need], np.int32)
             with autograd.no_grad():
@@ -754,13 +848,17 @@ class ServingEngine:
                 if self.flight is not None:
                     self.flight.on_prefill_chunk(
                         req, now, this_time[i], req.prefill_pos)
-                if req.prefill_pos >= req.prompt_len:
+                if req.prefill_pos >= req.prefill_target:
                     tok = int(nxt[need.index(i)])
-                    req.first_token_time = now
-                    self.obs.on_first_token(req, now)
-                    if self.flight is not None:
-                        self.flight.on_first_token(
-                            req, now, now - req.arrival_time)
+                    if req.first_token_time is None:
+                        # TTFT observes exactly ONCE per request — a
+                        # resumed request's re-prefill completion emits
+                        # a continuation token, not a first token
+                        req.first_token_time = now
+                        self.obs.on_first_token(req, now)
+                        if self.flight is not None:
+                            self.flight.on_first_token(
+                                req, now, now - req.arrival_time)
                     self._emit(req, tok)
                     emitted += 1
                     self._record_host(slot, req, tok)
@@ -776,10 +874,13 @@ class ServingEngine:
     def _emit(self, req, tok):
         """Append ONE generated token to a request's stream (retirement
         rule included) and count it — the obs token counter matches the
-        emitted streams exactly because every append goes through
-        here."""
+        emitted streams exactly because every append goes through here.
+        The front door's ``token_sink`` fires on the same boundary (the
+        streaming API's per-token push)."""
         req.record(tok, self.eos_token_id)
         self.obs.on_token(req)
+        if self.token_sink is not None:
+            self.token_sink(req, int(tok))
 
     def _record_host(self, slot, req, tok):
         self._last_tok[slot] = tok
@@ -792,8 +893,16 @@ class ServingEngine:
         slot's fold_in(key, n_emitted)."""
         if self.decode_strategy == "greedy":
             return np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
-        filt = _filter_logits(logits, self.top_k, self.top_p,
-                              self.temperature)
+        if self._per_request_sampling:
+            temps = jnp.asarray(np.asarray(
+                [self._temps[r.slot] for r in rows], np.float32))
+            filt = _filter_logits(
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None],
+                self.top_k, self.top_p, None)
+        else:
+            filt = _filter_logits(logits, self.top_k, self.top_p,
+                                  self.temperature)
         keys = jnp.asarray(np.stack(
             [self._keys[r.slot] for r in rows]))
         steps = jnp.asarray(np.asarray(
@@ -803,11 +912,21 @@ class ServingEngine:
         return np.asarray(samp).astype(np.int32)
 
     # -- the jitted decode quantum ----------------------------------------
-    def _select_device(self, logits, keys, n_gen):
+    def _select_device(self, logits, keys, n_gen, temps=None):
         if self.decode_strategy == "greedy":
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        filt = _filter_logits(logits, self.top_k, self.top_p,
-                              self.temperature)
+        if temps is not None:
+            # per-slot temperature (the front-door quantum variant):
+            # same scale-then-filter order — and the same f32 division
+            # — as the engine-wide path, so a uniform temps row
+            # replays the engine-wide engine bit-for-bit
+            filt = _filter_logits(
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None],
+                self.top_k, self.top_p, None)
+        else:
+            filt = _filter_logits(logits, self.top_k, self.top_p,
+                                  self.temperature)
         step_keys = jax.vmap(jax.random.fold_in)(keys, n_gen)
         return jax.vmap(jax.random.categorical)(
             step_keys, filt).astype(jnp.int32)
@@ -819,8 +938,8 @@ class ServingEngine:
         has_eos = self.eos_token_id is not None
         eos = -1 if self.eos_token_id is None else int(self.eos_token_id)
 
-        def quantum(kc, vc, p_vals, tables, seq_lens, last_tok, n_gen,
-                    done, max_new, keys):
+        def scan_steps(kc, vc, p_vals, tables, seq_lens, last_tok,
+                       n_gen, done, max_new, keys, temps):
             def body(carry, _):
                 kc, vc, seq_lens, last_tok, n_gen, done = carry
                 live = ~done
@@ -834,7 +953,7 @@ class ServingEngine:
                         model, fwd,
                         [Tensor(last_tok[:, None], stop_gradient=True)],
                         {}, p_vals, [])
-                nxt = self._select_device(logits, keys, n_gen)
+                nxt = self._select_device(logits, keys, n_gen, temps)
                 nxt = jnp.where(done, last_tok, nxt).astype(jnp.int32)
                 n_gen2 = n_gen + live.astype(jnp.int32)
                 done2 = done | (n_gen2 >= max_new)
@@ -848,6 +967,23 @@ class ServingEngine:
                     body, (kc, vc, seq_lens, last_tok, n_gen, done),
                     None, length=t_steps)
             return kc, vc, seq_lens, last_tok, n_gen, done, toks
+
+        if self._per_request_sampling:
+            # the front-door variant: per-slot temperature rides the
+            # existing per-slot state as ONE extra (S,) f32 input —
+            # its own recipe (serving_frontdoor_step) and golden pin
+            # this signature; the default quantum below is untouched
+            def quantum(kc, vc, p_vals, tables, seq_lens, last_tok,
+                        n_gen, done, max_new, keys, temps):
+                return scan_steps(kc, vc, p_vals, tables, seq_lens,
+                                  last_tok, n_gen, done, max_new, keys,
+                                  temps)
+        else:
+            def quantum(kc, vc, p_vals, tables, seq_lens, last_tok,
+                        n_gen, done, max_new, keys):
+                return scan_steps(kc, vc, p_vals, tables, seq_lens,
+                                  last_tok, n_gen, done, max_new, keys,
+                                  None)
 
         return quantum
 
@@ -864,12 +1000,15 @@ class ServingEngine:
                     jnp.asarray(self._n_gen), jnp.asarray(self._done),
                     jnp.asarray(self._max_new),
                     jnp.asarray(self._keys))
-        return (list(self.pool.k_pools), list(self.pool.v_pools),
+        args = (list(self.pool.k_pools), list(self.pool.v_pools),
                 self._p_vals, jnp.asarray(self._tables),
                 jnp.asarray(self._seq_lens),
                 jnp.asarray(self._last_tok), jnp.asarray(self._n_gen),
                 jnp.asarray(self._done), jnp.asarray(self._max_new),
                 jnp.asarray(self._keys))
+        if self._per_request_sampling:
+            args = args + (jnp.asarray(self._temps),)
+        return args
 
     def _spec_round_step(self):
         """Dispatch ONE jitted speculative round (draft-γ scan + target

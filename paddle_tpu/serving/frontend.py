@@ -1,0 +1,339 @@
+"""The async serving front door: streaming request API, priority
+preemption, SLO-aware load shedding, and graceful drain over the
+continuous-batching :class:`~paddle_tpu.serving.engine.ServingEngine`
+(reference: the serving *system* around AnalysisPredictor /
+``Predictor.run`` — PAPER.md §2.6/§3.5 — that turns the engine loop
+into a product; entry point ``paddle.inference.serve()``).
+
+What the front door adds, all as HOST-SIDE policy at the engine's
+existing scheduler boundaries (the compiled quantum's
+``max_host_callbacks=0`` budget and golden fingerprint are unchanged —
+the ``serving_frontdoor_step`` analysis recipe pins the
+per-request-sampling quantum variant with its own golden):
+
+- **token-by-token streaming**: :meth:`ServingFrontDoor.submit`
+  returns a :class:`TokenStream` — iterate it synchronously (each pull
+  pumps the engine) or ``async for`` it under :meth:`run_async`; the
+  engine's ``token_sink`` hook pushes every emitted token the moment
+  the host sees it.
+- **per-request generation params**: ``max_new_tokens`` / ``seed``
+  ride the existing per-slot state; ``temperature`` rides the
+  front-door quantum variant's per-slot temps input
+  (``per_request_sampling=True``); ``stop_token_ids`` /
+  ``stop_sequences`` are host-side stop rules (``finish_reason ==
+  "stop"``, truncate-at-stop convention like eos).
+- **priority preemption**: under pool pressure the pump evicts a
+  strictly-lower-priority victim (policy.py's :func:`choose_victim`),
+  returning its blocks to the pool (refcount-safe) and requeueing it
+  for RECOMPUTE-ON-RESUME — re-admission of a longer prompt whose
+  continuation is bit-exact vs an undisturbed run, with TTFT observed
+  exactly once (tests/test_serving's preemption oracle).
+- **SLO-aware load shedding + backpressure**: admission consults the
+  burn-rate health report (``engine.health()``, cached
+  ``health_interval_s``) and queue depth through
+  :class:`~paddle_tpu.serving.policy.FrontDoorPolicy`; shed requests
+  fire the obs ``on_shed`` hook (bad-outcome sample — the shed rate
+  burns the error-rate SLO) and their flight journal captures.
+- **graceful drain**: :meth:`drain` stops NEW admissions (submissions
+  shed with reason ``draining``), finishes everything already
+  accepted, and flushes the flight recorder.
+
+Benched by ``scripts/bench_serving.py serving_overload`` (p95 TTFT +
+shed rate under a >capacity Poisson burst, shed vs no-shed arms;
+artifact BENCH_FRONTDOOR_r10.json).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from .policy import NORMAL, FrontDoorPolicy, choose_victim
+from .scheduler import Request
+
+__all__ = ["TokenStream", "ServingFrontDoor"]
+
+
+class TokenStream:
+    """One request's streaming handle.
+
+    Sync: ``for tok in stream`` — each pull pumps the front door until
+    a token lands or the request finishes. Async: ``async for tok in
+    stream`` under a running :meth:`ServingFrontDoor.run_async` task.
+    ``stream.result()`` drives to completion and returns the generated
+    ids as one int32 array; ``stream.request`` is the live
+    :class:`~paddle_tpu.serving.scheduler.Request` (``finish_reason``:
+    ``eos`` | ``stop`` | ``length`` | ``shed``)."""
+
+    def __init__(self, request, frontdoor):
+        self.request = request
+        self._fd = frontdoor
+        self._buf = deque()
+        self._closed = False
+        self._aevent = None  # lazy: only async consumers pay for it
+
+    # -- producer side (the front door's token sink) ----------------------
+    def _push(self, tok):
+        self._buf.append(int(tok))
+        self._wake()
+
+    def _close(self):
+        self._closed = True
+        self._wake()
+
+    def _wake(self):
+        if self._aevent is not None:
+            self._aevent.set()
+
+    # -- consumer side -----------------------------------------------------
+    @property
+    def closed(self):
+        return self._closed
+
+    @property
+    def shed(self):
+        return self.request.finish_reason == "shed"
+
+    @property
+    def finish_reason(self):
+        return self.request.finish_reason
+
+    def __iter__(self):
+        while True:
+            while self._buf:
+                yield self._buf.popleft()
+            if self._closed:
+                return
+            self._fd.pump()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        while True:
+            if self._buf:
+                return self._buf.popleft()
+            if self._closed:
+                raise StopAsyncIteration
+            if self._aevent is None:
+                self._aevent = asyncio.Event()
+            await self._aevent.wait()
+            self._aevent.clear()
+
+    def result(self):
+        """Drain this stream to completion (pumping as needed) and
+        return the full generated id row as int32."""
+        for _ in self:
+            pass
+        return np.asarray(self.request.tokens, np.int32)
+
+
+class ServingFrontDoor:
+    """The serving system around one engine: submissions pass the
+    shedding policy, the pump applies preemption before every scheduler
+    iteration, and every emitted token streams out through
+    :class:`TokenStream`.
+
+    Args:
+        engine: a :class:`~paddle_tpu.serving.engine.ServingEngine`
+            (build with ``slo=`` for health-driven shedding and
+            ``flight=`` for drain-flushable journals;
+            ``paddle.inference.serve()`` wires the stock setup).
+        policy: a :class:`~paddle_tpu.serving.policy.FrontDoorPolicy`
+            (default: stock ladder — shed BATCH at warn, BATCH+NORMAL
+            at critical, preemption on).
+    """
+
+    def __init__(self, engine, policy=None):
+        self.engine = engine
+        self.policy = policy if policy is not None else FrontDoorPolicy()
+        if engine.token_sink is not None:
+            raise ValueError(
+                "engine already has a token_sink — one front door per "
+                "engine")
+        engine.token_sink = self._on_token
+        self._streams = {}       # req_id -> TokenStream
+        self.shed_requests = []  # Request handles refused admission
+        self._shed_seq = 0
+        self._draining = False
+        self._stopped = False
+        self._health = ("ok", float("-inf"))  # (state, stamped at)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, priority=NORMAL,
+               temperature=None, stop_token_ids=None,
+               stop_sequences=None, seed=0, req_id=None):
+        """Admit-or-shed one request; always returns a
+        :class:`TokenStream` (a shed request's stream is already closed
+        with ``finish_reason == "shed"`` — check ``stream.shed``)."""
+        eng = self.engine
+        now = eng.obs.now()
+        if self._draining:
+            return self._shed(prompt, max_new_tokens, priority, seed,
+                              req_id, now, reason="draining")
+        admit, reason = self.policy.admission(
+            priority, self._health_state(now),
+            waiting_depth=len(eng.scheduler.waiting))
+        if not admit:
+            return self._shed(prompt, max_new_tokens, priority, seed,
+                              req_id, now, reason=reason)
+        req = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                         req_id=req_id, seed=seed, priority=priority,
+                         temperature=temperature,
+                         stop_token_ids=stop_token_ids,
+                         stop_sequences=stop_sequences,
+                         arrival_time=now)
+        stream = TokenStream(req, self)
+        self._streams[str(req.req_id)] = stream
+        return stream
+
+    def _shed(self, prompt, max_new_tokens, priority, seed, req_id,
+              now, reason):
+        """Refuse one submission: the request never touches the
+        scheduler; obs records the bad-outcome sample (the shed rate
+        burns the error-rate SLO) and the flight recorder captures the
+        (short) journal — shedding IS an anomaly."""
+        eng = self.engine
+        if req_id is None:
+            req_id = f"shed{self._shed_seq}"
+        self._shed_seq += 1
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      req_id=req_id, seed=seed, priority=priority,
+                      arrival_time=now)
+        req.finished = True
+        req.finish_reason = "shed"
+        req.finish_time = now
+        if eng.flight is not None:
+            eng.flight.on_submit(req, now)
+            eng.flight.on_shed(req, now, reason=reason)
+        eng.obs.on_shed(req, now)
+        self.shed_requests.append(req)
+        stream = TokenStream(req, self)
+        stream._close()
+        return stream
+
+    def _health_state(self, now):
+        """The engine's burn-rate health state, re-evaluated at most
+        every ``policy.health_interval_s`` (no SLOs attached -> always
+        ``ok``: shedding then rests on backpressure alone)."""
+        if self.engine.slo is None:
+            return "ok"
+        state, stamped = self._health
+        if now - stamped < self.policy.health_interval_s:
+            return state
+        state = self.engine.health(now=now)["state"]
+        self._health = (state, now)
+        return state
+
+    # -- the pump ----------------------------------------------------------
+    def _on_token(self, req, tok):
+        stream = self._streams.get(str(req.req_id))
+        if stream is None:
+            return
+        stream._push(tok)
+        if req.finished:
+            stream._close()
+            self._streams.pop(str(req.req_id), None)
+
+    def _apply_preemption(self):
+        """Before admitting: if the highest-priority waiting request
+        cannot fit, evict strictly-lower-priority victims until it can
+        (or no victim remains). Equal priority never preempts — no
+        thrash between peers — and a resumed victim can itself only be
+        preempted again by a strictly higher class."""
+        if not self.policy.preempt:
+            return 0
+        sched = self.engine.scheduler
+        head = sched.next_waiting()
+        if head is None:
+            return 0
+        n = 0
+        while (n < self.policy.max_preemptions_per_pump
+                and not sched.can_admit(head)):
+            victim = choose_victim(sched.live(), head.priority)
+            if victim is None:
+                break
+            self.engine.preempt(victim)
+            n += 1
+        return n
+
+    def pump(self):
+        """One front-door iteration: preemption policy, then one engine
+        scheduler step (admit -> mixed prefill | decode quantum ->
+        retire). Returns True while work remains."""
+        self._apply_preemption()
+        return self.engine.step()
+
+    def run_until_idle(self):
+        """Drive synchronously until no work remains; returns the
+        engine's completed-request list."""
+        while self.engine.has_work:
+            self.pump()
+        return self.engine.completed
+
+    async def run_async(self, idle_s=0.001):
+        """The serving loop as a coroutine: pump while work exists
+        (yielding to the event loop between dispatches so streaming
+        consumers run), sleep briefly when idle, exit on :meth:`stop`
+        or when a drain completes."""
+        import asyncio
+
+        self._stopped = False
+        while not self._stopped:
+            if self.engine.has_work:
+                self.pump()
+                await asyncio.sleep(0)
+            elif self._draining:
+                break
+            else:
+                await asyncio.sleep(idle_s)
+
+    def stop(self):
+        """Stop :meth:`run_async` after its current iteration (no
+        drain: queued work stays queued)."""
+        self._stopped = True
+
+    # -- drain -------------------------------------------------------------
+    def drain(self, flight_path=None):
+        """Graceful drain: stop accepting NEW submissions (they shed
+        with reason ``draining``), finish everything already accepted
+        — queued and in-flight — then flush the flight recorder
+        (optionally to ``flight_path`` as JSONL). Returns a summary
+        dict; the front door stays drained (build a new one to
+        serve again)."""
+        eng = self.engine
+        if not self._draining:
+            self._draining = True
+            eng.obs.on_drain(eng.obs.now(),
+                             live=len(eng.scheduler.live()),
+                             waiting=len(eng.scheduler.waiting))
+        while eng.has_work:
+            self.pump()
+        out = {
+            "drained": True,
+            "completed": len(eng.completed),
+            "shed": len(self.shed_requests),
+            "preempted": eng.scheduler.preempted_total,
+            "resumed": eng.scheduler.resumed_total,
+        }
+        if eng.flight is not None:
+            out["flight"] = eng.flight.stats()
+            if flight_path is not None:
+                out["flight_path"] = eng.flight.save(flight_path)
+        return out
+
+    # -- views -------------------------------------------------------------
+    @property
+    def draining(self):
+        return self._draining
+
+    def stats(self):
+        """Front-door counters merged over the engine's: shed /
+        preempted / resumed / drain state next to the engine stats."""
+        out = self.engine.engine_stats()
+        out["shed"] = len(self.shed_requests)
+        out["draining"] = self._draining
+        out["open_streams"] = len(self._streams)
+        return out

@@ -4,15 +4,25 @@ engine (reference: the serving loop around AnalysisPredictor /
 §2.6/§3.5; the scheduler itself mirrors the 2.6-era
 BlockInferencePredictor's slot/block accounting — unverified, SURVEY §0).
 
-Pure host-side bookkeeping: a FIFO admission queue, a fixed table of
+Pure host-side bookkeeping: a priority admission queue (FIFO within a
+priority class, strict priority across classes), a fixed table of
 ``num_slots`` serving slots (the padded active set the jitted decode
 step is compiled for), and conservative block accounting against the
 shared :class:`~paddle_tpu.nlp.paged_cache.PagedKVCachePool` — a request
 is admitted only when its WORST-CASE block demand
 (``ceil((prompt + max_new) / block_size)``) fits under the pool capacity
 left unreserved by in-flight requests, so the pool can never exhaust
-mid-decode and no preemption path is needed. Retirement returns both the
-reservation and the actual blocks (``pool.free``) for immediate reuse.
+mid-decode. Retirement returns both the reservation and the actual
+blocks (``pool.free``) for immediate reuse.
+
+PREEMPTION (the front door's pool-pressure valve, serving/policy.py):
+:meth:`Scheduler.preempt` evicts a live request — its blocks go back to
+every pool (refcount-safe release), its reservation and slot are freed,
+and the request re-enters the head of its priority class as a LONGER
+PROMPT: resume is plain re-admission, and the re-prefill of
+``prompt + tokens-so-far`` recomputes the evicted KV
+(recompute-on-resume; worst-case demand is unchanged, so admission
+accounting needs no new case).
 """
 from __future__ import annotations
 
@@ -28,11 +38,31 @@ class Request:
 
     Lifecycle: ``waiting`` (queued) -> ``prefill`` (admitted to a slot,
     prompt entering the pool chunk by chunk) -> ``decode`` (in the
-    jitted quantum) -> ``finished`` (eos | max_new; blocks freed).
+    jitted quantum) -> ``finished`` (eos | stop | max_new; blocks
+    freed). A PREEMPTED request cycles back to ``waiting`` with its
+    emitted tokens appended to the prefill source (``begin_resume``),
+    so resume is re-admission of a longer prompt.
+
+    Per-request generation params (the front door's knobs, all applied
+    at host boundaries or through existing per-slot device state):
+
+    - ``seed``: per-slot PRNG key for the sampling arm (existing).
+    - ``max_new_tokens``: per-slot retirement bound (existing).
+    - ``temperature``: per-slot logits scale — requires an engine built
+      with ``per_request_sampling=True`` (the per-slot temperature
+      array is an input of the front-door quantum variant).
+    - ``stop_token_ids`` / ``stop_sequences``: host-side stop rules
+      checked as tokens are appended (``finish_reason == "stop"``; the
+      device mask keeps the slot riding until the quantum boundary,
+      exactly like the truncate-at-eos convention).
+    - ``priority``: admission class (see serving/policy.py —
+      BATCH < NORMAL < INTERACTIVE); higher admits first and may
+      preempt strictly-lower classes under pool pressure.
     """
 
     def __init__(self, prompt, max_new_tokens=32, req_id=None, seed=0,
-                 arrival_time=0.0):
+                 arrival_time=0.0, priority=1, temperature=None,
+                 stop_token_ids=None, stop_sequences=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -42,12 +72,22 @@ class Request:
         self.req_id = req_id
         self.seed = int(seed)
         self.arrival_time = float(arrival_time)
+        self.priority = int(priority)
+        self.temperature = (None if temperature is None
+                            else float(temperature))
+        self.stop_token_ids = frozenset(
+            int(t) for t in (stop_token_ids or ()))
+        self.stop_sequences = [
+            [int(t) for t in s] for s in (stop_sequences or ()) if s]
         # mutable state
         self.slot = None
-        self.prefill_pos = 0          # prompt tokens already in the pool
+        self.prefill_pos = 0          # prefill tokens already in the pool
+        self.prefill_target = self.prompt_len
+        self._prefill_src = self.prompt
+        self.preemptions = 0
         self.tokens: list = []        # generated token ids (incl. eos)
         self.finished = False
-        self.finish_reason = None     # "eos" | "length"
+        self.finish_reason = None     # "eos" | "stop" | "length" | "shed"
         self.admit_time = None
         self.first_token_time = None
         self.finish_time = None
@@ -57,24 +97,59 @@ class Request:
         return int(self.prompt.shape[0])
 
     @property
+    def prefill_src(self):
+        """The token row prefill pushes through the pool: the prompt,
+        or prompt + emitted tokens after a preemption (recompute-on-
+        resume re-prefills the evicted KV and the continuation token
+        falls out of the final position's logits)."""
+        return self._prefill_src
+
+    @property
     def prefilling(self):
-        return self.slot is not None and self.prefill_pos < self.prompt_len
+        return (self.slot is not None
+                and self.prefill_pos < self.prefill_target)
 
     @property
     def decoding(self):
         return (self.slot is not None and not self.finished
-                and self.prefill_pos >= self.prompt_len)
+                and self.prefill_pos >= self.prefill_target)
+
+    def begin_resume(self):
+        """Reset to the waiting state after an eviction: the next
+        admission re-prefills ``prompt + tokens`` from position 0 (the
+        emitted stream itself is untouched — the continuation must be
+        bit-exact vs an undisturbed run)."""
+        self.preemptions += 1
+        self.slot = None
+        self.prefill_pos = 0
+        if self.tokens:
+            self._prefill_src = np.concatenate(
+                [self.prompt, np.asarray(self.tokens, np.int32)])
+        self.prefill_target = int(self._prefill_src.shape[0])
+
+    def _hits_stop(self):
+        if self.tokens and self.tokens[-1] in self.stop_token_ids:
+            return True
+        for s in self.stop_sequences:
+            if len(self.tokens) >= len(s) \
+                    and self.tokens[-len(s):] == s:
+                return True
+        return False
 
     def record(self, token, eos_token_id=None):
         """Append one emitted token and apply the retirement rule the
-        device mask uses (eos emitted, or max_new reached). Returns True
-        while the request stays live."""
+        device mask uses (eos emitted, or max_new reached) plus the
+        host-side per-request stop rules. Returns True while the
+        request stays live."""
         if self.finished:
             return False
         self.tokens.append(int(token))
         if eos_token_id is not None and int(token) == int(eos_token_id):
             self.finished = True
             self.finish_reason = "eos"
+        elif self._hits_stop():
+            self.finished = True
+            self.finish_reason = "stop"
         elif len(self.tokens) >= self.max_new_tokens:
             self.finished = True
             self.finish_reason = "length"
@@ -133,11 +208,16 @@ class Scheduler:
         self._reservations = {}  # req -> worst-case block count
         self.admitted_total = 0
         self.finished_total = 0
+        self.preempted_total = 0
+        self.resumed_total = 0
+        self._submitted_total = 0  # monotonic req_id source (a derived
+        # id like admitted+waiting can repeat once preemption requeues)
 
     # -- queue -------------------------------------------------------------
     def submit(self, request):
         if request.req_id is None:
-            request.req_id = f"req{self.admitted_total + len(self.waiting)}"
+            request.req_id = f"req{self._submitted_total}"
+        self._submitted_total += 1
         self.waiting.append(request)
         return request
 
@@ -156,32 +236,85 @@ class Scheduler:
         return min([self.pool.num_blocks]
                    + [p.num_blocks for p in self.companion_pools])
 
+    def next_waiting(self):
+        """The request admission would try next: the OLDEST request of
+        the HIGHEST priority class present (stable within a class —
+        FIFO per priority, strict priority across classes). None when
+        the queue is empty."""
+        best = None
+        for r in self.waiting:
+            if best is None or r.priority > best.priority:
+                best = r
+        return best
+
+    def can_admit(self, req):
+        """Would ``req`` be admitted right now? (a free slot exists and
+        its worst-case demand fits under the live reservations) — the
+        pressure signal the preemption policy keys on."""
+        if not any(s is None for s in self.slots):
+            return False
+        return (self.reserved_blocks + self._demand(req)
+                <= self._capacity)
+
     def try_admit(self):
         """Move waiting requests into free slots while their worst-case
-        block demand fits; returns the newly admitted requests (FIFO —
-        a too-big head blocks the queue rather than starving)."""
+        block demand fits; returns the newly admitted requests.
+        Selection is priority-then-FIFO (``next_waiting``), and a
+        too-big head BLOCKS its class and everything below rather than
+        starving (no bypass: admitting a small low-priority request
+        around a blocked high-priority head would invert priority)."""
         admitted = []
         while self.waiting:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
                 break
-            req = self.waiting[0]
+            req = self.next_waiting()
             need = self._demand(req)
             if need > self._capacity - self._base_reserved:
-                self.waiting.popleft()
+                self.waiting.remove(req)
                 raise ValueError(
                     f"request {req.req_id}: needs {need} blocks, pool "
                     f"only has {self._capacity - self._base_reserved} "
                     f"usable — raise num_blocks or split the request")
             if self.reserved_blocks + need > self._capacity:
                 break
-            self.waiting.popleft()
+            self.waiting.remove(req)
             req.slot = free[0]
             self.slots[free[0]] = req
             self._reservations[req] = need
-            self.admitted_total += 1
+            # a request with preemptions behind it was admitted before:
+            # this admission is the RESUME half of a preempt/resume
+            # pair, not new work
+            if req.preemptions:
+                self.resumed_total += 1
+            else:
+                self.admitted_total += 1
             admitted.append(req)
         return admitted
+
+    def preempt(self, req):
+        """Evict a LIVE request under pool pressure: release its blocks
+        in every pool (refcount-safe — a shared block only returns to
+        the free list when its last holder lets go), drop the
+        reservation, free the slot, and requeue it at the HEAD of the
+        waiting queue as a longer prompt (``Request.begin_resume``) so
+        resume is plain re-admission + re-prefill."""
+        if req.slot is None or req.finished:
+            raise ValueError(
+                f"request {req.req_id} is not live (slot={req.slot}, "
+                f"finished={req.finished}): only an in-flight request "
+                f"can be preempted")
+        self.pool.free(req.req_id)
+        for p in self.companion_pools:
+            p.free(req.req_id)
+        self._reservations.pop(req, None)
+        self.slots[req.slot] = None
+        req.begin_resume()
+        # head of the deque: the stable scan in next_waiting() puts a
+        # resumed request ahead of its class (it was admitted first)
+        self.waiting.appendleft(req)
+        self.preempted_total += 1
+        return req
 
     def retire(self, req):
         """Release a finished request's slot, reservation, and pool

@@ -12,7 +12,7 @@ sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
 serving_engine | speculative_decode | speculative_serving |
-serving_obs_overhead | slo_overhead
+serving_obs_overhead | slo_overhead | serving_overload
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -980,6 +980,16 @@ def slo_overhead():
     return _bench_serving().slo_overhead()
 
 
+def serving_overload():
+    """Front-door acceptance row (ISSUE 7): p95 TTFT + shed rate under
+    a >capacity Poisson burst through paddle.inference.serve(), shed
+    arm (SLO-burn-rate admission + backpressure + priority preemption)
+    vs the no-shed pass-through — shedding must bound the admitted
+    TTFT tail while the no-shed arm degrades with the backlog (see
+    scripts/bench_serving.py, artifact BENCH_FRONTDOOR_r10.json)."""
+    return _bench_serving().serving_overload()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -988,6 +998,7 @@ CONFIGS = {
     "speculative_serving": speculative_serving,
     "serving_obs_overhead": serving_obs_overhead,
     "slo_overhead": slo_overhead,
+    "serving_overload": serving_overload,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

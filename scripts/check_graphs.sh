@@ -27,5 +27,15 @@ python -m paddle_tpu.analysis --check --fingerprint
 # demo engine: lenient objectives read ok, impossible ones critical,
 # and every forced threshold crossing dumps a schema-valid flight
 # journal.
+#
+# Front-door gate (ISSUE 7): the `--check --fingerprint` pass above
+# also audits `serving_frontdoor_step` (the per-request-sampling
+# quantum variant built through the full policy tier after a forced
+# preemption: 0 host callbacks, pools donated, its own golden), and
+# `obs check` runs the front-door smoke — a forced priority preemption
+# must fire the preempted/resumed/recomputed counters, resume must
+# continue the stream, drain must flush the flight journals, and the
+# watch dashboard must render the overload line. H106/H107 lint covers
+# serving/{frontend,policy}.py through the repo-wide scan above.
 python -m paddle_tpu.obs check
 echo "check_graphs: lint + budgets + fingerprints (+obs) all green"

@@ -15,7 +15,19 @@ rejection-sampling arm replays the plain sampling engine bit-for-bit
 when draft == target on fixed seeds, eos/max-new retirement composes
 with variable per-round yield, admission accounts for the draft pool,
 and the ``speculative_verify_step`` budget pins the one-dispatch
-round."""
+round.
+
+The FRONT DOOR's engine tier (ISSUE 7): the preemption correctness
+oracle — a preempted-then-resumed request's stream is BIT-EXACT vs an
+undisturbed run in both the greedy and fixed-seed sampling arms, with
+TTFT observed exactly once despite the re-prefill — plus per-request
+temperature threading (a uniform-temps front-door engine replays the
+engine-wide sampling engine bit-for-bit), host-side stop rules,
+refcount-safe pool release (shared blocks survive one holder's
+eviction), a 100-round ragged preempt/resume leak hunt at the
+scheduler level, priority admission ordering, and the
+``serving_frontdoor_step`` budget + golden pinning the
+per-slot-temperature quantum variant."""
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -72,23 +84,50 @@ def test_engine_greedy_oracle_ragged(tiny_model):
     """The correctness oracle: 5 ragged requests over 3 slots (so
     retirement + slot/block reuse happens mid-run), chunked prefill
     interleaved with decode — outputs bit-exact vs per-request
-    sequential generate."""
+    sequential generate. The same run carries the ISSUE 7 preemption
+    oracle (request 0 is evicted mid-decode and resumes by re-prefill
+    of prompt+tokens: its stream must STILL be bit-exact, with TTFT
+    observed exactly once despite the re-prefill) and the host-side
+    stop-token rule (request 4 stops at a token its own oracle row
+    predicts — truncate-at-stop, finish_reason "stop")."""
     cfg, model = tiny_model
     rng = np.random.RandomState(0)
     prompts = [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
                for n in (5, 9, 3, 12, 7)]
     max_new = [6, 4, 8, 5, 7]
+    wants = [_oracle_row(model, p, mn)
+             for p, mn in zip(prompts, max_new)]
+    # request 4 additionally carries a stop rule on its 3rd generated
+    # token; its expected output is the oracle row truncated there
+    stop_tok = int(wants[4][prompts[4].shape[0] + 2])
+    wants[4] = wants[4][:prompts[4].shape[0] + 3]
     engine = ServingEngine(model, num_slots=3, block_size=4,
                            prefill_chunk=4, decode_quantum=3)
-    reqs = [engine.submit(p, max_new_tokens=mn)
-            for p, mn in zip(prompts, max_new)]
+    reqs = [engine.submit(p, max_new_tokens=mn,
+                          stop_token_ids=[stop_tok] if i == 4 else None)
+            for i, (p, mn) in enumerate(zip(prompts, max_new))]
+    # evict request 0 mid-decode: blocks back to the pool, requeued at
+    # the head of its class, resumed via re-prefill
+    while len(reqs[0].tokens) < 2:
+        engine.step()
+    assert not reqs[0].finished
+    engine.preempt(reqs[0])
+    assert reqs[0].slot is None and reqs[0].prefill_pos == 0
+    assert reqs[0].prefill_target == prompts[0].shape[0] + len(
+        reqs[0].tokens)
     done = engine.run()
     assert len(done) == len(reqs)
     assert engine.scheduler.finished_total == len(reqs)
-    for req, p, mn in zip(reqs, prompts, max_new):
-        want = _oracle_row(model, p, mn)
-        got = engine.output_tokens(req)
-        np.testing.assert_array_equal(got, want)
+    for req, want in zip(reqs, wants):
+        np.testing.assert_array_equal(engine.output_tokens(req), want)
+    assert reqs[4].finish_reason == "stop"
+    # TTFT observed exactly once per request despite req0's re-prefill
+    assert engine.obs.registry.get(
+        "serving_ttft_seconds").count() == len(reqs)
+    st = engine.engine_stats()
+    assert st["preempted"] == 1 and st["resumed"] == 1
+    assert engine.obs.registry.get(
+        "serving_tokens_recomputed_total").value() >= 2
     # every request retired -> all its blocks are back on the free list
     stats = engine.pool.fragmentation_stats()
     assert stats["blocks_in_use"] == 1  # only the engine scratch block
@@ -144,6 +183,57 @@ def test_engine_rejects_oversize_and_bad_strategy(tiny_model):
                       max_new_tokens=8)
     with pytest.raises(ValueError, match="greedy|sampling"):
         ServingEngine(model, decode_strategy="beam")
+
+
+# ------------------------------------------------ preemption oracle
+def test_preemption_and_temperature_sampling_bit_exact(
+        tiny_model, sampling_prompts, plain_sampling_outputs):
+    """ISSUE 7 oracle, fixed-seed sampling arm — one front-door engine
+    (per_request_sampling=True) proves two bit-exactness claims against
+    the module-shared plain sampling run at once: (a) per-request
+    TEMPERATURE threads through the per-slot temps input of the
+    front-door quantum variant (every request passes the temperature
+    the engine-wide fixture used — uniform temps must replay it
+    bit-for-bit), and (b) the fold_in(key, n_emitted) token-stream
+    discipline survives EVICTION — a preempted request re-prefills and
+    continues the SAME sample stream, with TTFT observed once."""
+    cfg, model = tiny_model
+    engine = ServingEngine(model, decode_quantum=3,
+                           per_request_sampling=True, **_SAMPLING_KW)
+    reqs = [engine.submit(p, max_new_tokens=5, seed=i,
+                          temperature=_SAMPLING_KW["temperature"])
+            for i, p in enumerate(sampling_prompts)]
+    while len(reqs[0].tokens) < 2:
+        engine.step()
+    assert not reqs[0].finished
+    engine.preempt(reqs[0])
+    engine.run()
+    for req, want in zip(reqs, plain_sampling_outputs):
+        np.testing.assert_array_equal(engine.output_tokens(req), want)
+    assert engine.scheduler.preempted_total == 1
+    assert engine.scheduler.resumed_total == 1
+    assert engine.obs.registry.get("serving_ttft_seconds").count() == 3
+
+
+def test_per_request_param_validation(tiny_model):
+    """Temperature needs the front-door quantum variant; the variant
+    needs the sampling strategy; stop rules are pure host checks."""
+    cfg, model = tiny_model
+    engine = ServingEngine(model, num_slots=2, block_size=4)
+    with pytest.raises(ValueError, match="per_request_sampling"):
+        engine.submit(np.arange(1, 5, dtype=np.int32), temperature=0.7)
+    with pytest.raises(ValueError, match="sampling"):
+        ServingEngine(model, per_request_sampling=True)
+    with pytest.raises(NotImplementedError, match="spec_draft"):
+        ServingEngine(model, decode_strategy="sampling",
+                      per_request_sampling=True, spec_draft=model)
+    # stop-sequence rule, host-side (no engine run needed)
+    req = Request(np.arange(1, 5), max_new_tokens=10,
+                  stop_sequences=[[7, 8]])
+    for t in (5, 7, 8):
+        req.record(t)
+    assert req.finished and req.finish_reason == "stop"
+    assert req.tokens == [5, 7, 8]
 
 
 # ------------------------------------------------ speculative arm
@@ -324,6 +414,116 @@ def test_pool_trim_releases_tail_blocks():
     assert pool.trim("missing", 3) == []
 
 
+def test_pool_refcount_share_release():
+    """Refcount-safe release (the eviction/prefix-sharing primitive):
+    a block shared by two holders survives the first free and only
+    returns to the free list — and counts as freed — when the LAST
+    holder releases it; double-release of an untracked block raises."""
+    pool = _pool(num_blocks=8, bs=4)
+    t_a = list(pool.ensure("a", 8))       # 2 blocks
+    t_b = pool.share("a", "b")            # aliases, refcount 2 each
+    assert t_b == t_a
+    assert pool.blocks_in_use == 2
+    pool.free("a")
+    # b still holds the blocks: nothing returned to the free list
+    assert pool.blocks_in_use == 2
+    assert pool.fragmentation_stats()["blocks_freed_total"] == 0
+    pool.free("b")
+    assert pool.blocks_in_use == 0
+    assert pool.fragmentation_stats()["blocks_freed_total"] == 2
+    pool.ensure("c", 4)
+    with pytest.raises(ValueError, match="already exists"):
+        pool.share("a", "c")
+    with pytest.raises(KeyError):
+        pool.share("missing", "d")
+    with pytest.raises(RuntimeError, match="double free"):
+        pool._release([t_a[0]])
+    # trim decrements too: a shared tail block is not freed early
+    pool2 = _pool(num_blocks=8, bs=4)
+    pool2.ensure("x", 8)
+    pool2.share("x", "y")
+    pool2.trim("x", 4)                    # x drops its tail block
+    assert pool2.blocks_in_use == 2       # y still maps it
+    pool2.free("y")
+    assert pool2.blocks_in_use == 1       # x's head block remains
+
+
+def test_preemption_no_block_leak_100_ragged_rounds():
+    """ISSUE 7 acceptance: 100 rounds of ragged admit / partial-ensure
+    / preempt / resume / retire churn at the scheduler+pool level —
+    blocks_in_use must return to zero every round and the free list
+    must be whole at the end (an off-by-one in eviction release would
+    leak monotonically and fail fast here)."""
+    rng = np.random.RandomState(0)
+    pool = _pool(num_blocks=24, bs=4)
+    sched = Scheduler(SchedulerConfig(num_slots=4), pool)
+    for round_i in range(100):
+        reqs = [Request(np.arange(1, 1 + rng.randint(2, 12)),
+                        max_new_tokens=int(rng.randint(1, 12)),
+                        priority=int(rng.randint(0, 3)))
+                for _ in range(rng.randint(1, 6))]
+        for r in reqs:
+            sched.submit(r)
+        live = sched.try_admit()
+        # simulate partial prefill/decode pool growth per live request
+        for r in live:
+            grown = min(r.prompt_len + rng.randint(0, r.max_new_tokens
+                                                   + 1),
+                        r.prompt_len + r.max_new_tokens)
+            pool.ensure(r.req_id, grown)
+        # preempt a random subset, resume them, then retire everything
+        for r in list(live):
+            if rng.rand() < 0.5:
+                sched.preempt(r)
+        sched.try_admit()  # resumed + any still-waiting requests
+        for r in [x for x in sched.slots if x is not None]:
+            pool.ensure(r.req_id, r.prompt_len + r.max_new_tokens)
+            r.finished = True
+            sched.retire(r)
+        # anything left waiting (slots exhausted) drains next round;
+        # flush it now so every round starts clean
+        while sched.waiting:
+            for r in sched.try_admit():
+                r.finished = True
+                sched.retire(r)
+        assert pool.blocks_in_use == 0, f"leak at round {round_i}"
+        assert sched.reserved_blocks == 0
+    assert pool.free_blocks == pool.num_blocks
+    assert sched.preempted_total > 0 and sched.resumed_total > 0
+
+
+def test_scheduler_priority_admission_and_preempt_requeue():
+    """Priority-then-FIFO admission: the highest class admits first
+    (stable within a class), a preempted request re-enters at the head
+    of its class, and ``can_admit`` reports slot/block pressure the
+    preemption policy keys on."""
+    pool = _pool(num_blocks=12, bs=4)
+    sched = Scheduler(SchedulerConfig(num_slots=2), pool)
+    lo = sched.submit(Request(np.arange(1, 5), max_new_tokens=4,
+                              priority=0))
+    mid = sched.submit(Request(np.arange(1, 5), max_new_tokens=4,
+                               priority=1))
+    hi = sched.submit(Request(np.arange(1, 5), max_new_tokens=4,
+                              priority=2))
+    assert sched.next_waiting() is hi
+    assert sched.try_admit() == [hi, mid]     # strict priority order
+    assert lo.slot is None
+    assert not sched.can_admit(lo)            # both slots taken
+    sched.preempt(mid)
+    assert sched.preempted_total == 1
+    assert mid.prefill_target == mid.prompt_len  # no tokens yet
+    # mid (priority 1) outranks lo in the queue again; lo keeps
+    # waiting for a slot
+    assert sched.next_waiting() is mid
+    assert sched.can_admit(mid)
+    assert sched.try_admit() == [mid]
+    assert sched.resumed_total == 1
+    hi.finished = True
+    sched.retire(hi)
+    assert sched.try_admit() == [lo]
+    assert sched.admitted_total == 3          # resume is not a new admit
+
+
 # ------------------------------------------------ scheduler accounting
 def test_scheduler_admission_gating():
     """Admission is gated on WORST-CASE demand (prompt + max_new) so the
@@ -391,6 +591,36 @@ def test_serving_decode_step_budget():
     assert report.donation.undonated() == []
     assert report.memory.temp_bytes is not None
     analysis.check_recipe_fingerprint("serving_decode_step", report)
+
+
+def test_serving_frontdoor_step_budget():
+    """ISSUE 7 acceptance: the front-door quantum variant (per-slot
+    temperature input, sampling selection in-graph), built through an
+    engine that just served a priority preemption + resume with the
+    FULL policy/obs tier attached, still has zero host callbacks, zero
+    involuntary remat, no collectives, every KV pool leaf donated —
+    and its own golden fingerprint matches, while the plain engines'
+    goldens are untouched (their tests above compare against the same
+    checked-in files as before). The whole policy layer provably never
+    enters the compiled program."""
+    from paddle_tpu import analysis
+
+    recipe = analysis.build_recipe("serving_frontdoor_step")
+    try:
+        report = recipe.check()
+        # the audited engine really went through the front door's
+        # overload path before the audit
+        assert recipe.engine.scheduler.preempted_total == 1
+        assert recipe.engine.scheduler.resumed_total == 1
+        assert len(report.remat_events) == 0
+        assert report.host_sync is not None \
+            and report.host_sync.count == 0
+        assert report.total_collectives == 0
+        assert report.donation.undonated() == []
+        analysis.check_recipe_fingerprint("serving_frontdoor_step",
+                                          report)
+    finally:
+        recipe.close()
 
 
 def test_speculative_verify_step_budget():

@@ -1,5 +1,6 @@
 """vision models + hapi Model + metric tests (config #1 surface)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -15,6 +16,9 @@ def test_resnet18_forward_shapes():
     assert out.shape == [2, 7]
 
 
+@pytest.mark.slow  # ~13s (full resnet18 fwd+bwd+opt steps); forward
+# shapes + the LeNet hapi fit flow keep the surface covered in tier-1
+# — the 870s ceiling forced a re-tier as the suite grew (PR 7)
 def test_resnet_train_step_decreases_loss():
     paddle.seed(0)
     net = resnet18(num_classes=4)
