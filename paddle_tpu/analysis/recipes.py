@@ -33,6 +33,13 @@ known-good graph shape.
   pools-donated caps — the machine proof that streaming, preemption,
   shedding and drain are ALL host-side policy that never enters the
   compiled program.
+- ``serving_prefix_step``: the PREFIX-CACHED engine's decode quantum
+  (``prefix_cache=True`` — content-addressed block reuse +
+  copy-on-write in the paged pool), audited after a real cache hit
+  and a real COW. Budget: identical caps to ``serving_decode_step`` —
+  the machine proof that the whole cache policy (chain-hash index,
+  attach/publish, COW, refcount eviction) is host-side allocator work
+  that never changes the compiled program.
 
 ``build(name)`` constructs the recipe (installing the mesh it needs)
 and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
@@ -306,12 +313,62 @@ def _build_serving_frontdoor_step():
     return recipe
 
 
+def _build_serving_prefix_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    # the PREFIX-CACHED engine (content-addressed block reuse +
+    # copy-on-write, nlp/paged_cache.py) with full observability on.
+    # The audited state is reached through a REAL cache hit: the first
+    # request publishes its two full prompt blocks at prefill
+    # completion, the second (identical prompt) aliases both at
+    # admission and copy-on-writes the tail block when its capped
+    # one-token re-prefill lands. All of that is host allocator
+    # policy — this recipe's golden proves the compiled quantum stays
+    # byte-identical to serving_decode_step's shape: 0 host callbacks,
+    # pools donated, collective-free, bf16 end to end.
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=4,
+                           prefix_cache=True,
+                           trace=True, slo=True, flight=True)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+    engine.submit(prompt.copy(), max_new_tokens=8)
+    engine.step()  # admit + full prefill -> publish both blocks
+    engine.submit(prompt.copy(), max_new_tokens=8)
+    engine.step()  # attach (2-block hit) + capped re-prefill -> COW
+    assert engine.pool.prefix_hits >= 2, engine.pool.prefix_hits
+    assert engine.pool.cow_copies >= 1, engine.pool.cow_copies
+    target, args = engine.decode_step_target()
+    budget = Budget(
+        name="prefix-cached serving quantum (bf16, single chip)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip serving program
+        max_f32_matmuls=0,        # bf16 pool/params stay bf16
+        max_host_callbacks=0,     # cache policy is host-side only
+        require_donated=True,     # the 2L KV pool leaves
+        # same caps as serving_decode_step: the prefix cache must not
+        # change the compiled quantum at all
+        max_temp_bytes=300_000,
+        max_peak_live_bytes=1_300_000,
+    )
+    recipe = Recipe("serving_prefix_step", target, args, budget)
+    recipe.engine = engine  # obs CLI asserts the instrumented engine
+    return recipe
+
+
 RECIPES = {
     "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
     "llama_decode_greedy": _build_llama_decode_greedy,
     "serving_decode_step": _build_serving_decode_step,
     "speculative_verify_step": _build_speculative_verify_step,
     "serving_frontdoor_step": _build_serving_frontdoor_step,
+    "serving_prefix_step": _build_serving_prefix_step,
 }
 
 

@@ -171,9 +171,15 @@ def create_serving_engine(model, **kwargs):
     ``flight=True`` (or a
     :class:`~paddle_tpu.obs.flight.FlightRecorder`) journals every
     request's lifecycle (including preempt/resume events), dumping the
-    journal on SLO-threshold crossings. Per-request knobs ride
-    ``engine.submit`` — priority, temperature, stop_token_ids,
-    stop_sequences, max_new_tokens, seed. See
+    journal on SLO-threshold crossings. ``prefix_cache=True``
+    (DEFAULT OFF this release) turns on content-addressed prefix
+    caching in the paged pool: admissions alias the longest cached
+    chain of full prompt blocks instead of re-prefilling them
+    (copy-on-write protects sharers; prefill compute and novel pool
+    residency scale with UNIQUE tokens — the shared-system-prompt
+    TTFT win), with streams bit-identical to the unshared engine.
+    Per-request knobs ride ``engine.submit`` — priority, temperature,
+    stop_token_ids, stop_sequences, max_new_tokens, seed. See
     :mod:`paddle_tpu.serving`."""
     from ..serving import ServingEngine
 
@@ -195,8 +201,12 @@ def serve(model, policy=None, slo=True, flight=True, **kwargs):
     ``slo`` / ``flight`` default ON (shedding needs the health report;
     drain flushes the journals); ``decode_strategy="sampling"``
     auto-enables ``per_request_sampling`` so ``submit(...,
-    temperature=)`` works per request. Remaining keyword args forward
-    to the engine (:func:`create_serving_engine` documents them).
+    temperature=)`` works per request. ``prefix_cache=True`` (DEFAULT
+    OFF this release) enables content-addressed prefix caching —
+    shared system prompts alias cached KV blocks instead of
+    re-prefilling, ``TokenStream.cached_prefix_tokens`` reports the
+    per-request win. Remaining keyword args forward to the engine
+    (:func:`create_serving_engine` documents them).
 
     ::
 

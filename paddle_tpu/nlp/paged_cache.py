@@ -7,6 +7,22 @@ shared pool so HBM scales with LIVE tokens, not batch × max_seq_len.
 The allocator is plain host Python (a free list); the device side is the
 pool arrays + int32 block tables consumed by
 ``ops/pallas/paged_attention``.
+
+CONTENT-ADDRESSED PREFIX CACHING (``prefix_cache=True``): full token
+blocks are published into an index keyed by a rolling hash that CHAINS
+over the prefix — a block's key folds its parent's key, so identical
+block content at different prefix depths never collides — and every
+bucket entry stores its (parent, token-tuple) key material, so even a
+forced hash collision verifies before it aliases. A new sequence whose
+prompt walks a cached chain ALIASES those physical blocks into its
+table (``attach_prefix`` — the refcounted ``share()`` primitive per
+block), paying neither prefill compute nor fresh residency for them;
+the first token WRITTEN into a shared block triggers copy-on-write
+(``make_writable``: allocate fresh, copy the pool rows, decref the
+shared block). The index itself holds one refcount per published
+block, so a cached block survives its sequences and is reclaimed —
+LRU, leaf-first so chains stay walkable — only under allocation
+pressure and only at refcount one (no live holder).
 """
 from __future__ import annotations
 
@@ -14,6 +30,41 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["PagedKVCachePool"]
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = (1 << 64) - 1
+
+
+def _chain_hash(parent_hash, tokens):
+    """Rolling FNV-1a over one block's token ids, seeded by the PARENT
+    block's chain hash — depth is part of the key, so the same content
+    at a different prefix depth hashes differently. Collisions are
+    still verified against the stored key material before any alias
+    (tests force this function to a constant to prove it)."""
+    h = (int(parent_hash) ^ _FNV_OFFSET) & _MASK64
+    for t in tokens:
+        h ^= int(t) & 0xFFFFFFFF
+        h = (h * _FNV_PRIME) & _MASK64
+    return h
+
+
+class _PrefixEntry:
+    """One published full block: a node of the prefix-chain trie. The
+    index holds ONE refcount on ``block`` for as long as the entry
+    lives; ``parent`` identity + the token tuple are the verified key
+    material behind the chain hash."""
+
+    __slots__ = ("hash", "parent", "tokens", "block", "nchildren",
+                 "tick")
+
+    def __init__(self, hash_, parent, tokens, block, tick):
+        self.hash = hash_
+        self.parent = parent
+        self.tokens = tokens
+        self.block = block
+        self.nchildren = 0
+        self.tick = tick
 
 
 class PagedKVCachePool:
@@ -27,7 +78,7 @@ class PagedKVCachePool:
     """
 
     def __init__(self, num_blocks, block_size, num_kv_heads, head_dim,
-                 num_layers=1, dtype=jnp.bfloat16):
+                 num_layers=1, dtype=jnp.bfloat16, prefix_cache=False):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_kv_heads = int(num_kv_heads)
@@ -43,20 +94,41 @@ class PagedKVCachePool:
         self._refcounts: dict = {}  # block id -> holders (>= 1 while out)
         self._peak_blocks = 0     # high-water mark of blocks_in_use
         self._freed_total = 0     # blocks returned over the pool's life
+        # content-addressed prefix index (enable_prefix_cache)
+        self._prefix_enabled = False
+        self._prefix_buckets: dict = {}  # chain hash -> [_PrefixEntry]
+        self._cached_blocks: dict = {}   # block id -> its entry
+        self._prefix_tick = 0            # LRU clock for eviction
+        self.prefix_hits = 0             # blocks served from the index
+        self.prefix_misses = 0           # full blocks that had to be built
+        self.cow_copies = 0              # copy-on-write block copies
+        self.prefix_aliases = 0          # share() aliases the index created
+        self.prefix_evictions = 0        # entries reclaimed under pressure
+        if prefix_cache:
+            self.enable_prefix_cache()
 
     # -- allocator ---------------------------------------------------------
+    def _alloc_block(self):
+        """Pop one free block, reclaiming cached-only prefix blocks
+        (LRU) when the free list runs dry — eviction under pressure
+        respects refcounts: only an index-sole-holder block is taken."""
+        if not self._free:
+            self.evict_prefix(1)
+        if not self._free:
+            raise RuntimeError(
+                f"KV pool exhausted ({self.num_blocks} blocks)")
+        return self._free.pop()
+
     def ensure(self, seq_id, new_total_tokens):
         """Grow ``seq_id``'s block table to cover ``new_total_tokens``."""
         table = self._tables.setdefault(seq_id, [])
         need = -(-int(new_total_tokens) // self.block_size)
         while len(table) < need:
-            if not self._free:
-                raise RuntimeError(
-                    f"KV pool exhausted ({self.num_blocks} blocks)")
-            blk = self._free.pop()
+            blk = self._alloc_block()
             self._refcounts[blk] = 1
             table.append(blk)
-        self._lens[seq_id] = int(new_total_tokens)
+        self._lens[seq_id] = max(self._lens.get(seq_id, 0),
+                                 int(new_total_tokens))
         self._peak_blocks = max(self._peak_blocks, self.blocks_in_use)
         return table
 
@@ -76,6 +148,248 @@ class PagedKVCachePool:
         self._tables[dst_seq_id] = list(src)
         self._lens[dst_seq_id] = self._lens.get(src_seq_id, 0)
         return self._tables[dst_seq_id]
+
+    # -- content-addressed prefix cache ------------------------------------
+    def enable_prefix_cache(self):
+        """Turn on the prefix index for this pool (off by default: the
+        index, the attach/publish walk, and COW checks only run for
+        pools that opted in, so an unshared pool's behavior — and its
+        compiled consumers — are byte-identical)."""
+        self._prefix_enabled = True
+
+    @property
+    def prefix_cache_enabled(self):
+        return self._prefix_enabled
+
+    @property
+    def cached_blocks(self):
+        """Blocks currently held by the prefix index (their content is
+        addressable by chain hash; resident but reclaimable once no
+        live sequence maps them)."""
+        return len(self._cached_blocks)
+
+    def _full_blocks(self, tokens):
+        return len(tokens) // self.block_size
+
+    def _block_tokens(self, tokens, i):
+        bs = self.block_size
+        return tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+
+    def _match_entries(self, tokens, max_blocks=None):
+        """Walk ``tokens``' full blocks down the chain; return the
+        longest VERIFIED entry chain (hash match alone never aliases —
+        parent identity + token tuple must both compare equal)."""
+        if not self._prefix_enabled:
+            return []
+        n = self._full_blocks(tokens)
+        if max_blocks is not None:
+            n = min(n, int(max_blocks))
+        entries, parent, h = [], None, 0
+        for i in range(n):
+            blk_toks = self._block_tokens(tokens, i)
+            h = _chain_hash(h, blk_toks)
+            hit = None
+            for e in self._prefix_buckets.get(h, ()):
+                if e.parent is parent and e.tokens == blk_toks:
+                    hit = e
+                    break
+            if hit is None:
+                break
+            entries.append(hit)
+            parent = hit
+        return entries
+
+    def match_prefix(self, tokens):
+        """Cached tokens a new sequence with this prompt could alias
+        (a whole number of full blocks; 0 when the cache is off/cold)."""
+        return len(self._match_entries(tokens)) * self.block_size
+
+    def prefix_match_stats(self, tokens, max_blocks=None):
+        """Admission-accounting view of a lookup: how many blocks would
+        alias, and how many of those are currently EVICTABLE (index is
+        the sole holder) — attaching pins them, so the scheduler's
+        novel-demand check must move them out of the reclaimable set."""
+        entries = self._match_entries(tokens, max_blocks=max_blocks)
+        ev = sum(1 for e in entries if self._refcounts.get(e.block) == 1)
+        return {"matched_blocks": len(entries),
+                "matched_tokens": len(entries) * self.block_size,
+                "evictable": ev}
+
+    def attach_prefix(self, seq_id, tokens, max_blocks=None):
+        """Alias the longest cached chain of ``tokens``' full blocks
+        into a NEW table for ``seq_id`` (per-block ``share()``:
+        refcounts bump, the sequence starts life ``matched_tokens``
+        deep). Returns the aliased token count; also counts the lookup
+        (hits = aliased blocks, misses = the prompt's other full
+        blocks), so call it once per admission even on a cold cache."""
+        if not self._prefix_enabled:
+            return 0
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already exists")
+        entries = self._match_entries(tokens, max_blocks=max_blocks)
+        self.prefix_hits += len(entries)
+        self.prefix_misses += max(
+            self._full_blocks(tokens) - len(entries), 0)
+        if not entries:
+            return 0
+        self._prefix_tick += 1
+        for e in entries:
+            self._refcounts[e.block] += 1
+            e.tick = self._prefix_tick
+        self._tables[seq_id] = [e.block for e in entries]
+        self._lens[seq_id] = len(entries) * self.block_size
+        self.prefix_aliases += len(entries)
+        return len(entries) * self.block_size
+
+    def publish_prefix(self, seq_id, tokens):
+        """Publish ``seq_id``'s now-written FULL blocks covering
+        ``tokens`` into the index (called at prefill completion, when
+        the host knows both the token ids and that their KV is in the
+        pool). Each newly indexed block gains one refcount — the
+        index's hold — so it outlives the sequence until evicted.
+        Chain positions already indexed (by this sequence's own attach,
+        or a racing twin) keep their existing entry. Returns the number
+        of newly published blocks."""
+        if not self._prefix_enabled:
+            return 0
+        table = self._tables.get(seq_id)
+        if table is None:
+            return 0
+        n = min(self._full_blocks(tokens), len(table))
+        self._prefix_tick += 1
+        parent, h, published = None, 0, 0
+        for i in range(n):
+            blk_toks = self._block_tokens(tokens, i)
+            h = _chain_hash(h, blk_toks)
+            hit = None
+            for e in self._prefix_buckets.get(h, ()):
+                if e.parent is parent and e.tokens == blk_toks:
+                    hit = e
+                    break
+            if hit is None:
+                blk = table[i]
+                if blk in self._cached_blocks:
+                    # this physical block already backs another chain
+                    # node — never double-index one block (the stats
+                    # and eviction accounting assume block -> entry is
+                    # one-to-one); stop publishing here
+                    break
+                hit = _PrefixEntry(h, parent, blk_toks, blk,
+                                   self._prefix_tick)
+                self._prefix_buckets.setdefault(h, []).append(hit)
+                self._cached_blocks[blk] = hit
+                self._refcounts[blk] += 1
+                if parent is not None:
+                    parent.nchildren += 1
+                published += 1
+            else:
+                hit.tick = self._prefix_tick
+            parent = hit
+        return published
+
+    def make_writable(self, seq_id, start_token, end_token):
+        """COPY-ON-WRITE: before a forward writes KV at positions
+        ``[start_token, end_token)``, give ``seq_id`` exclusive
+        ownership of every block in that range. A shared block
+        (refcount > 1 — other sequences and/or the prefix index still
+        map it) is replaced by a fresh block carrying a device-side
+        copy of its pool rows, and the shared block is decref'd; the
+        other holders never see the write. Returns the number of
+        blocks copied (0 on exclusively-owned fast path)."""
+        table = self._tables.get(seq_id)
+        if not table or end_token <= start_token:
+            return 0
+        bs = self.block_size
+        lo = max(int(start_token) // bs, 0)
+        hi = min((int(end_token) - 1) // bs, len(table) - 1)
+        copies = 0
+        for j in range(lo, hi + 1):
+            blk = table[j]
+            if self._refcounts.get(blk, 1) <= 1:
+                continue
+            fresh = self._alloc_block()
+            for i in range(self.num_layers):
+                self.k_pools[i] = self.k_pools[i].at[fresh].set(
+                    self.k_pools[i][blk])
+                self.v_pools[i] = self.v_pools[i].at[fresh].set(
+                    self.v_pools[i][blk])
+            self._refcounts[fresh] = 1
+            table[j] = fresh
+            self._release([blk])
+            copies += 1
+            self.cow_copies += 1
+        if copies:
+            self._peak_blocks = max(self._peak_blocks,
+                                    self.blocks_in_use)
+        return copies
+
+    def evictable_prefix_blocks(self):
+        """Cached blocks reclaimable RIGHT NOW: the index is their sole
+        holder (refcount == 1 — no live sequence maps them)."""
+        return sum(1 for b in self._cached_blocks
+                   if self._refcounts.get(b) == 1)
+
+    def _drop_entry(self, e):
+        bucket = self._prefix_buckets.get(e.hash, [])
+        bucket.remove(e)
+        if not bucket:
+            self._prefix_buckets.pop(e.hash, None)
+        if e.parent is not None:
+            e.parent.nchildren -= 1
+        del self._cached_blocks[e.block]
+        self._release([e.block])
+        self.prefix_evictions += 1
+
+    def evict_prefix(self, n):
+        """Reclaim up to ``n`` cached blocks under allocation pressure:
+        LRU over LEAF entries (no children — dropping a mid-chain node
+        would orphan its descendants) whose block the index solely
+        holds. A block a live sequence still maps is never touched
+        (refcount > 1), so eviction can starve before ``n`` — the
+        caller's exhaustion error stands. Returns blocks reclaimed."""
+        freed = 0
+        while freed < n:
+            best = None
+            for b, e in self._cached_blocks.items():
+                if e.nchildren or self._refcounts.get(b) != 1:
+                    continue
+                if best is None or e.tick < best.tick:
+                    best = e
+            if best is None:
+                break
+            self._drop_entry(best)
+            freed += 1
+        return freed
+
+    def clear_prefix_cache(self):
+        """Release EVERY index hold (leaf-first so parents become
+        droppable) — the leak-audit teardown: after the sequences are
+        freed too, ``free_blocks`` must equal ``num_blocks`` and the
+        refcount map must be empty."""
+        dropped = 0
+        while self._cached_blocks:
+            leaves = [e for e in self._cached_blocks.values()
+                      if e.nchildren == 0]
+            if not leaves:  # cycle-proof: chains are trees, can't happen
+                raise RuntimeError("prefix index has no leaf entries")
+            for e in leaves:
+                self._drop_entry(e)
+                dropped += 1
+        return dropped
+
+    def prefix_cache_stats(self):
+        """Monotonic counters + live index occupancy (the obs layer
+        syncs the counters into the metrics registry at step
+        boundaries)."""
+        return {
+            "hits": self.prefix_hits,
+            "misses": self.prefix_misses,
+            "cow_copies": self.cow_copies,
+            "aliased_blocks": self.prefix_aliases,
+            "evictions": self.prefix_evictions,
+            "cached_blocks": self.cached_blocks,
+            "evictable_blocks": self.evictable_prefix_blocks(),
+        }
 
     def _release(self, blocks):
         """Refcount-safe return path shared by free/trim: decrement each
@@ -124,11 +438,20 @@ class PagedKVCachePool:
 
     def can_allocate(self, total_tokens):
         """Admission-control check: could a NEW sequence of
-        ``total_tokens`` be allocated right now?"""
-        return self.blocks_needed(total_tokens) <= len(self._free)
+        ``total_tokens`` be allocated right now? Cached-only prefix
+        blocks count as available — ``_alloc_block`` evicts them on
+        demand when the free list runs dry."""
+        return (self.blocks_needed(total_tokens)
+                <= len(self._free) + self.evictable_prefix_blocks())
 
     def seq_len(self, seq_id):
         return self._lens.get(seq_id, 0)
+
+    def held_blocks(self, seq_id):
+        """Blocks ``seq_id``'s table currently maps (shared or
+        exclusive) — the scheduler's novel-demand accounting subtracts
+        this from a live request's worst-case demand."""
+        return len(self._tables.get(seq_id, ()))
 
     @property
     def blocks_in_use(self):
@@ -144,9 +467,28 @@ class PagedKVCachePool:
         each sequence's last block) — blocks are unit-sized so external
         fragmentation cannot occur. ``utilization`` is live tokens over
         allocated token capacity (1.0 when every allocated slot holds a
-        live token)."""
-        live = sum(self._lens.get(s, 0) for s in self._tables)
+        live token).
+
+        REFCOUNT-AWARE: a physical block shared by several sequences
+        (prefix aliasing) is counted ONCE — its live coverage is the
+        max any holder covers — and a cached-only block (held solely by
+        the prefix index) counts as fully live; summing per-sequence
+        lengths would claim utilization > 1 on a shared pool. For an
+        unshared pool this reduces exactly to the old per-sequence
+        sum."""
+        bs = self.block_size
+        coverage: dict = {}
+        for s, table in self._tables.items():
+            length = self._lens.get(s, 0)
+            for j, blk in enumerate(table):
+                c = min(bs, max(length - j * bs, 0))
+                if c > coverage.get(blk, 0):
+                    coverage[blk] = c
+        for blk in self._cached_blocks:
+            coverage[blk] = bs  # published blocks are full by contract
+        live = sum(coverage.values())
         cap = self.blocks_in_use * self.block_size
+        shared = sum(1 for n in self._refcounts.values() if n > 1)
         return {
             "num_blocks": self.num_blocks,
             "blocks_in_use": self.blocks_in_use,
@@ -156,6 +498,8 @@ class PagedKVCachePool:
             "live_tokens": live,
             "tail_waste_tokens": cap - live,
             "utilization": (live / cap) if cap else 1.0,
+            "shared_blocks": shared,
+            "cached_blocks": len(self._cached_blocks),
         }
 
     def bytes_in_use(self):

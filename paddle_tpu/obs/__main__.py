@@ -30,17 +30,20 @@ terminal dashboard — polling a running exporter's ``/snapshot`` +
 
 ``check`` is the instrumentation-can't-change-the-graph gate used by
 ``scripts/check_graphs.sh``: it builds the serving + speculative +
-front-door analysis recipes — whose engines run with FULL
-observability (registry + tracer + SLOs + flight recorder) — re-checks
-their budgets, compares the golden fingerprints, and asserts the
-instrumentation actually recorded (metrics counted, trace validates).
-It then runs the SLO smoke on the demo engine (lenient objectives must
-read ``ok``, impossible ones ``critical``, forced threshold crossings
-must produce schema-valid anomaly journals) and the FRONT-DOOR smoke
-(ISSUE 7: a forced priority preemption must fire the
+front-door + prefix-cache analysis recipes — whose engines run with
+FULL observability (registry + tracer + SLOs + flight recorder) —
+re-checks their budgets, compares the golden fingerprints, and asserts
+the instrumentation actually recorded (metrics counted, trace
+validates). It then runs the SLO smoke on the demo engine (lenient
+objectives must read ``ok``, impossible ones ``critical``, forced
+threshold crossings must produce schema-valid anomaly journals), the
+FRONT-DOOR smoke (ISSUE 7: a forced priority preemption must fire the
 preempted/resumed/recomputed counters, resume bit-continuously, drain
 must flush the flight journals, and the dashboard must render the
-overload line). Exit non-zero on drift.
+overload line), and the PREFIX-CACHE smoke (ISSUE 9: a forced cache
+hit + copy-on-write must fire the prefix counters, keep the streams
+bit-identical to an unshared engine, and render the dashboard's
+prefix line). Exit non-zero on drift.
 """
 from __future__ import annotations
 
@@ -235,7 +238,7 @@ def _cmd_watch(args):
 
 
 _CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step",
-                  "serving_frontdoor_step")
+                  "serving_frontdoor_step", "serving_prefix_step")
 
 
 def _check_slo_smoke():
@@ -334,6 +337,84 @@ def _check_frontdoor_smoke():
           f"{summary['flight']['captured_total']} journals")
 
 
+def _check_prefix_smoke():
+    """The prefix-cache smoke (ISSUE 9): force a cache hit and a
+    copy-on-write on a tiny engine — one request publishes its prompt
+    blocks, an identical prompt aliases them (capped one token short,
+    so its re-prefill COWs the tail block) — then assert the registry
+    counters fired, the streams are bit-identical to an UNSHARED
+    engine's, the per-request cached-token count surfaced, pool
+    accounting stayed sane (utilization <= 1 with sharing live), and
+    the dashboard renders the prefix line."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+    from .export import render_dashboard
+    from .flight import FlightRecorder
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+
+    def drive(prefix):
+        engine = ServingEngine(model, num_slots=2, block_size=4,
+                               prefill_chunk=8, decode_quantum=2,
+                               prefix_cache=prefix, slo=True,
+                               # impossible thresholds: every journal
+                               # captures, so the admit events (with
+                               # their cached/novel block counts) stay
+                               # inspectable after retirement
+                               flight=FlightRecorder(
+                                   ttft_threshold=1e-9,
+                                   e2e_threshold=1e-9))
+        first = engine.submit(prompt.copy(), max_new_tokens=4)
+        engine.step()  # prefill + publish before the twin arrives
+        second = engine.submit(prompt.copy(), max_new_tokens=4)
+        engine.run()
+        return engine, first, second
+
+    plain, p1, p2 = drive(False)
+    cached, c1, c2 = drive(True)
+    if (p1.tokens, p2.tokens) != (c1.tokens, c2.tokens):
+        raise AssertionError(
+            f"prefix-cached streams diverged: {c1.tokens}/{c2.tokens} "
+            f"vs unshared {p1.tokens}/{p2.tokens}")
+    if c2.cached_prefix_tokens != 8:
+        raise AssertionError(
+            f"twin aliased {c2.cached_prefix_tokens} tokens, "
+            f"expected its full 8-token prompt")
+    pool = cached.pool
+    if pool.prefix_hits < 2 or pool.cow_copies < 1:
+        raise AssertionError(
+            f"forced hit/COW did not fire: hits={pool.prefix_hits} "
+            f"cow={pool.cow_copies}")
+    reg = cached.obs.registry
+    for m in ("serving_prefix_cache_hits_total",
+              "serving_prefix_cache_cow_copies_total",
+              "serving_prefix_cache_shared_blocks_total"):
+        if reg.get(m).value(pool="target") < 1:
+            raise AssertionError(f"registry counter {m} never fired")
+    st = pool.fragmentation_stats()
+    if st["utilization"] > 1.0:
+        raise AssertionError(
+            f"refcount-aware utilization broke: {st}")
+    frame = render_dashboard(reg.snapshot())
+    if "prefix[" not in frame:
+        raise AssertionError("dashboard frame missing prefix line")
+    admits = [e for j in cached.flight.records()
+              for e in j["events"] if e["kind"] == "admit"]
+    if not any(e.get("cached_blocks") for e in admits):
+        raise AssertionError(
+            "flight admit events carry no cached-block counts")
+    print(f"prefix smoke: hits={pool.prefix_hits} "
+          f"misses={pool.prefix_misses} cow={pool.cow_copies} "
+          f"cached_blocks={pool.cached_blocks}, streams bit-identical "
+          f"to the unshared engine")
+
+
 def _cmd_check(args):
     """Instrumented-fingerprint gate: the serving recipes construct
     their engines with full observability ON (analysis/recipes.py);
@@ -381,6 +462,11 @@ def _cmd_check(args):
     except (AssertionError, ValueError) as e:
         failed = True
         print(f"front-door smoke: FAIL — {e}", file=sys.stderr)
+    try:
+        _check_prefix_smoke()
+    except (AssertionError, ValueError) as e:
+        failed = True
+        print(f"prefix smoke: FAIL — {e}", file=sys.stderr)
     if failed:
         return 1
     print("obs check: instrumentation-enabled fingerprints unchanged")

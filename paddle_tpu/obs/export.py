@@ -302,5 +302,14 @@ def render_dashboard(snapshot, report=None, width=62):
             lines.append(
                 f" pool[{pool:<6}] blocks {in_use:>6.0f} in use, "
                 f"{free:>6.0f} free, util {util:6.1%}")
+        hits = g("serving_prefix_cache_hits_total", pool=pool)
+        misses = g("serving_prefix_cache_misses_total", pool=pool)
+        if hits or misses:
+            cow = g("serving_prefix_cache_cow_copies_total", pool=pool)
+            frac = g("serving_prefix_cache_cached_block_fraction",
+                     pool=pool)
+            lines.append(
+                f" prefix[{pool:<4}] hits {hits:>6.0f}  misses "
+                f"{misses:>6.0f}  cow {cow:>4.0f}  cached {frac:6.1%}")
     lines.append(bar)
     return "\n".join(lines) + "\n"

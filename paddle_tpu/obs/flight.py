@@ -113,12 +113,18 @@ class FlightRecorder:
         self._event(req, "submit", t)
 
     def on_admit(self, req, t, queue_wait=None, blocks_reserved=None,
-                 pool_free_blocks=None, pool_blocks_in_use=None):
+                 pool_free_blocks=None, pool_blocks_in_use=None,
+                 cached_blocks=None, novel_blocks=None):
+        """``cached_blocks`` / ``novel_blocks`` split the admission's
+        block demand between prefix-cache aliases (no prefill compute,
+        no fresh residency) and blocks it must still populate."""
         self._event(req, "admit", t, slot=int(req.slot),
                     queue_wait_s=queue_wait,
                     blocks_reserved=blocks_reserved,
                     pool_free_blocks=pool_free_blocks,
-                    pool_blocks_in_use=pool_blocks_in_use)
+                    pool_blocks_in_use=pool_blocks_in_use,
+                    cached_blocks=cached_blocks,
+                    novel_blocks=novel_blocks)
 
     def on_prefill_chunk(self, req, t, tokens, pos):
         """``tokens`` prompt tokens entered the pool this mixed step;

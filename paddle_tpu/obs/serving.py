@@ -23,8 +23,11 @@ Exported serving metrics (all host-boundary):
   assert), the front door's overload counters
   ``serving_requests_{shed,preempted,resumed}_total`` /
   ``serving_tokens_recomputed_total`` / ``serving_drains_total``
-  (serving/frontend.py), plus the legacy ``serving_*_total`` counters
-  behind ``engine.stats``.
+  (serving/frontend.py), the prefix-cache counters
+  ``serving_prefix_cache_{hits,misses,cow_copies,shared_blocks}_total``
+  ``{pool=target|draft}`` (synced from the pool's monotonic counters
+  at step boundaries when the engine runs ``prefix_cache=True``), plus
+  the legacy ``serving_*_total`` counters behind ``engine.stats``.
 - histograms: ``serving_queue_wait_seconds``, ``serving_ttft_seconds``
   (observed exactly once per request, at the prefill-completion step
   that emits its first token), ``serving_e2e_latency_seconds``,
@@ -33,7 +36,9 @@ Exported serving metrics (all host-boundary):
 - gauges: ``serving_tokens_per_second_window`` (trailing-window
   throughput), ``serving_spec_acceptance_rate`` (per-round),
   ``serving_slots_occupied``, ``serving_pool_{blocks_in_use,
-  free_blocks,utilization}{pool=target|draft}``.
+  free_blocks,utilization}{pool=target|draft}``,
+  ``serving_prefix_cache_cached_block_fraction{pool=target|draft}``
+  (index-held blocks over blocks in use).
 - time series (host ring buffers, not prometheus):
   :meth:`timeseries` — ``tokens_per_s`` and ``spec_acceptance_rate``
   points for offline plots, plus the PER-REQUEST sample series the SLO
@@ -189,6 +194,30 @@ class ServingObs:
             "resume)")
         self._c_drains = r.counter(
             "serving_drains_total", "graceful drains started")
+        # content-addressed prefix cache (engine prefix_cache=True):
+        # the pool keeps monotonic counters on its hot path; on_step
+        # carries their deltas into the registry, so the metrics cost
+        # nothing inside the allocator
+        self._c_pc_hits = r.counter(
+            "serving_prefix_cache_hits_total",
+            "full prompt blocks served from the prefix index")
+        self._c_pc_misses = r.counter(
+            "serving_prefix_cache_misses_total",
+            "full prompt blocks that had to be prefilled")
+        self._c_pc_cow = r.counter(
+            "serving_prefix_cache_cow_copies_total",
+            "copy-on-write copies (first write into a shared block)")
+        self._c_pc_shared = r.counter(
+            "serving_prefix_cache_shared_blocks_total",
+            "block aliases the prefix index created at admission")
+        self._g_pc_frac = r.gauge(
+            "serving_prefix_cache_cached_block_fraction",
+            "index-held blocks / blocks in use")
+        # (pool identity, counter attr) -> last value synced; keyed by
+        # id() so engines sharing one registry don't cross-credit, and
+        # kept OUT of reset() so a registry reset restarts the counters
+        # from zero without replaying the pool's full history
+        self._pc_marks = {}
         self._window = deque()
         self._cum_tokens = 0
         self._series = {
@@ -373,6 +402,8 @@ class ServingObs:
             self._g_blocks.set(st["blocks_in_use"], pool=label)
             self._g_free.set(st["free_blocks"], pool=label)
             self._g_util.set(st["utilization"], pool=label)
+            if getattr(p, "prefix_cache_enabled", False):
+                self._sync_prefix(label, p, st)
         if self.tracer is not None:
             self.tracer.counter(
                 "occupancy", now,
@@ -380,6 +411,25 @@ class ServingObs:
             self.tracer.counter(
                 "pool_blocks", now,
                 {label: p.blocks_in_use for label, p in pools})
+
+    def _sync_prefix(self, label, pool, st):
+        """Carry one pool's monotonic prefix-cache counters into the
+        registry as DELTAS since the last step, and refresh the
+        cached-block-fraction gauge."""
+        for attr, c in (("prefix_hits", self._c_pc_hits),
+                        ("prefix_misses", self._c_pc_misses),
+                        ("cow_copies", self._c_pc_cow),
+                        ("prefix_aliases", self._c_pc_shared)):
+            v = getattr(pool, attr)
+            key = (id(pool), attr)
+            delta = v - self._pc_marks.get(key, 0)
+            if delta:
+                c.inc(delta, pool=label)
+            self._pc_marks[key] = v
+        in_use = st["blocks_in_use"]
+        self._g_pc_frac.set(
+            (st["cached_blocks"] / in_use) if in_use else 0.0,
+            pool=label)
 
     def on_quantum(self, kind, t0, t1, tokens, rows):
         """One dispatch boundary: ``kind`` is ``mixed`` (chunked

@@ -20,12 +20,22 @@ preemption (evict-and-recompute-on-resume, bit-exact continuation),
 sheds load off the SLO burn-rate health report
 (:class:`FrontDoorPolicy`), and drains gracefully.
 
+PREFIX CACHING (``ServingEngine(prefix_cache=True)``, default off):
+the pool's content-addressed index
+(:mod:`paddle_tpu.nlp.paged_cache`) lets admissions alias full prompt
+blocks another request already prefilled — copy-on-write isolates
+writers, refcount-aware eviction reclaims cached blocks only at
+refcount one, and the scheduler admits on NOVEL block demand. Streams
+stay bit-identical to the unshared engine; prefill compute scales
+with unique tokens.
+
 The compiled programs are pinned by the ``serving_decode_step`` /
-``speculative_verify_step`` / ``serving_frontdoor_step`` analysis
-Budgets (zero involuntary remat, zero host callbacks, KV pools
-donated). Benched by ``scripts/bench_serving.py`` (ragged Poisson
-arrivals, speculative serving vs the plain quantum, and the
-``serving_overload`` shed/no-shed burst rows).
+``speculative_verify_step`` / ``serving_frontdoor_step`` /
+``serving_prefix_step`` analysis Budgets (zero involuntary remat,
+zero host callbacks, KV pools donated). Benched by
+``scripts/bench_serving.py`` (ragged Poisson arrivals, speculative
+serving vs the plain quantum, the ``serving_overload`` shed/no-shed
+burst rows, and the ``shared_prefix`` cached/unshared arms).
 """
 from .scheduler import Request, Scheduler, SchedulerConfig
 from .engine import ServingEngine

@@ -329,6 +329,20 @@ class ServingEngine:
             greedy arm emits exactly the target's greedy stream; the
             sampling arm is distribution-exact rejection sampling.
         spec_gamma: proposals per speculative round (default 4).
+        prefix_cache: enable CONTENT-ADDRESSED PREFIX CACHING
+            (default OFF this release): full prompt blocks are
+            published into the pool's chain-hash index at prefill
+            completion, and admission aliases the longest cached chain
+            into the new request's block tables (target + draft pool in
+            lockstep) — prefill then skips the aliased tokens, so
+            prefill compute and novel pool residency scale with UNIQUE
+            tokens (the shared-system-prompt TTFT win). The first
+            token written into a still-shared block copy-on-writes it;
+            eviction under pool pressure reclaims cached blocks only
+            at refcount one. All of it is host-side allocator policy:
+            the compiled quantum, its golden fingerprint, and the
+            emitted streams are bit-identical either way (the
+            ``serving_prefix_step`` recipe gates this).
         per_request_sampling: build the FRONT-DOOR quantum variant
             (requires ``decode_strategy="sampling"``): each slot's
             temperature rides the per-slot state as one extra (S,)
@@ -373,7 +387,8 @@ class ServingEngine:
                  max_context=None, prefill_chunk=64, decode_quantum=8,
                  decode_strategy="greedy", top_k=0, top_p=1.0,
                  temperature=1.0, eos_token_id=None, spec_draft=None,
-                 spec_gamma=4, per_request_sampling=False, obs=None,
+                 spec_gamma=4, prefix_cache=False,
+                 per_request_sampling=False, obs=None,
                  trace=False, slo=None, flight=None):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
@@ -438,9 +453,11 @@ class ServingEngine:
         w = -(-(self.max_context + margin) // bs)
         if num_blocks is None:
             num_blocks = s * w + 1  # +1: the masked-write scratch block
+        self.prefix_cache = bool(prefix_cache)
         self.pool = PagedKVCachePool(
             num_blocks, bs, cfg.num_key_value_heads, cfg.head_dim,
-            num_layers=cfg.num_hidden_layers, dtype=cache_dtype)
+            num_layers=cfg.num_hidden_layers, dtype=cache_dtype,
+            prefix_cache=self.prefix_cache)
         # masked (retired/empty) rows dump their KV writes here
         self._scratch_block = self.pool.ensure("__scratch__", 1)[0]
         self.d_pool = None
@@ -452,7 +469,8 @@ class ServingEngine:
             self.d_pool = PagedKVCachePool(
                 num_blocks, bs, d_cfg.num_key_value_heads,
                 d_cfg.head_dim, num_layers=d_cfg.num_hidden_layers,
-                dtype=self._d_p_vals[0].dtype)
+                dtype=self._d_p_vals[0].dtype,
+                prefix_cache=self.prefix_cache)
             self._d_scratch_block = self.d_pool.ensure("__scratch__",
                                                        1)[0]
         self.scheduler = Scheduler(
@@ -662,6 +680,11 @@ class ServingEngine:
             out["spec_acceptance_rate"] = (
                 self.stats["spec_accepted"]
                 / max(self.stats["spec_proposed"], 1))
+        if self.prefix_cache:
+            out["prefix_cache"] = self.pool.prefix_cache_stats()
+            if self.d_pool is not None:
+                out["draft_prefix_cache"] = \
+                    self.d_pool.prefix_cache_stats()
         return out
 
     def decode_step_target(self):
@@ -699,14 +722,28 @@ class ServingEngine:
                 self.obs.on_admit(req, now)
                 if self.flight is not None:
                     st = self.pool.fragmentation_stats()
+                    reserved = self.scheduler._reservations.get(req)
+                    cached_blk = self.pool.held_blocks(req.req_id)
                     self.flight.on_admit(
                         req, now, queue_wait=now - req.arrival_time,
-                        blocks_reserved=self.scheduler._reservations.get(
-                            req),
+                        blocks_reserved=reserved,
                         pool_free_blocks=st["free_blocks"],
-                        pool_blocks_in_use=st["blocks_in_use"])
+                        pool_blocks_in_use=st["blocks_in_use"],
+                        cached_blocks=cached_blk,
+                        novel_blocks=(None if reserved is None
+                                      else reserved - cached_blk))
             slot = req.slot
-            self._seq_lens[slot] = 0
+            cached = 0
+            if self.prefix_cache and req.cached_prefix_tokens:
+                # never skip the WHOLE prefill source: the final
+                # position is re-prefilled (a one-token chunk) so
+                # completion still emits a token — and that write is
+                # the designed copy-on-write trigger for the tail
+                # shared block when the entire prompt was cached
+                cached = min(req.cached_prefix_tokens,
+                             req.prefill_target - 1)
+                req.prefill_pos = cached
+            self._seq_lens[slot] = cached
             self._n_gen[slot] = 0
             self._done[slot] = True  # not decodable until prefill ends
             self._max_new[slot] = req.max_new_tokens
@@ -798,6 +835,16 @@ class ServingEngine:
             self.pool.ensure(req.req_id, req.prefill_pos + n)
             if spec:
                 self.d_pool.ensure(req.req_id, req.prefill_pos + n)
+            if self.prefix_cache:
+                # copy-on-write before the forward: the chunk's KV
+                # writes must never land in a block another holder
+                # (sequence or prefix index) still maps
+                self.pool.make_writable(req.req_id, req.prefill_pos,
+                                        req.prefill_pos + n)
+                if spec:
+                    self.d_pool.make_writable(
+                        req.req_id, req.prefill_pos,
+                        req.prefill_pos + n)
         for req in dec:
             slot = req.slot
             toks.append(np.asarray([self._last_tok[slot]], np.int32))
@@ -808,6 +855,11 @@ class ServingEngine:
             if spec:
                 self.d_pool.ensure(req.req_id,
                                    int(self._seq_lens[slot]) + 1)
+            if self.prefix_cache:
+                seq = int(self._seq_lens[slot])
+                self.pool.make_writable(req.req_id, seq, seq + 1)
+                if spec:
+                    self.d_pool.make_writable(req.req_id, seq, seq + 1)
         ids = np.concatenate(toks).astype(np.int32)
         total = int(ids.shape[0])
         self.stats["prefill_tokens"] += int(sum(enc_lens))
@@ -849,6 +901,17 @@ class ServingEngine:
                     self.flight.on_prefill_chunk(
                         req, now, this_time[i], req.prefill_pos)
                 if req.prefill_pos >= req.prefill_target:
+                    if self.prefix_cache:
+                        # the whole prefill source is in the pool now:
+                        # publish its full blocks into the prefix index
+                        # (both pools — lockstep) so the next request
+                        # with this prefix aliases instead of computing
+                        self.pool.publish_prefix(req.req_id,
+                                                 req.prefill_src)
+                        if spec:
+                            self.d_pool.publish_prefix(
+                                req.req_id, req.prefill_src)
+                        self.scheduler.clear_cow_debt(req)
                     tok = int(nxt[need.index(i)])
                     if req.first_token_time is None:
                         # TTFT observes exactly ONCE per request — a
@@ -1030,6 +1093,9 @@ class ServingEngine:
                                  (self.d_pool, self._d_tables)):
                 if need > pool.seq_len(req.req_id):
                     pool.ensure(req.req_id, need)
+                if self.prefix_cache:
+                    pool.make_writable(req.req_id,
+                                       int(self._seq_lens[slot]), need)
                 row = pool.block_table_array(
                     [req.req_id], pad_to=self._table_width)
                 tables[slot] = np.asarray(row)[0][:self._table_width]
@@ -1087,6 +1153,9 @@ class ServingEngine:
             need = min(int(self._seq_lens[slot]) + t_steps, cap)
             if need > self.pool.seq_len(req.req_id):
                 self.pool.ensure(req.req_id, need)
+            if self.prefix_cache:
+                self.pool.make_writable(req.req_id,
+                                        int(self._seq_lens[slot]), need)
             row = self.pool.block_table_array(
                 [req.req_id], pad_to=self._table_width)
             self._tables[slot] = np.asarray(row)[0][:self._table_width]

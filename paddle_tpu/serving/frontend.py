@@ -37,6 +37,11 @@ per-request-sampling quantum variant with its own golden):
 - **graceful drain**: :meth:`drain` stops NEW admissions (submissions
   shed with reason ``draining``), finishes everything already
   accepted, and flushes the flight recorder.
+- **prefix-cache visibility**: on a ``prefix_cache=True`` engine,
+  ``TokenStream.cached_prefix_tokens`` reports how many prompt tokens
+  this request aliased from the content-addressed prefix index
+  (prefill skipped them — the shared-system-prompt TTFT win), and
+  :meth:`stats` carries the engine's ``prefix_cache`` counter block.
 
 Benched by ``scripts/bench_serving.py serving_overload`` (p95 TTFT +
 shed rate under a >capacity Poisson burst, shed vs no-shed arms;
@@ -97,6 +102,15 @@ class TokenStream:
     @property
     def finish_reason(self):
         return self.request.finish_reason
+
+    @property
+    def cached_prefix_tokens(self):
+        """Prompt tokens this request aliased from the prefix cache at
+        its latest admission (0 on an unshared engine or a cache miss):
+        tokens that paid NO prefill compute and no fresh pool
+        residency — the per-request view of the shared-system-prompt
+        TTFT win."""
+        return self.request.cached_prefix_tokens
 
     def __iter__(self):
         while True:

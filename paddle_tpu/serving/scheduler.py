@@ -23,6 +23,16 @@ PROMPT: resume is plain re-admission, and the re-prefill of
 ``prompt + tokens-so-far`` recomputes the evicted KV
 (recompute-on-resume; worst-case demand is unchanged, so admission
 accounting needs no new case).
+
+PREFIX-CACHE-AWARE ADMISSION (pool ``prefix_cache=True``): admission
+attaches the longest cached chain of the prefill source into the
+request's tables in EVERY pool (target + draft in lockstep) and the fit
+check counts only NOVEL block demand — each live request's remaining
+table growth plus pending copy-on-write debt, and the candidate's
+``demand - matched`` — against the free list plus what prefix eviction
+can reclaim (minus the matched blocks this admission pins). With the
+cache off (the default) the check reduces byte-for-byte to the static
+worst-case reservation above.
 """
 from __future__ import annotations
 
@@ -84,6 +94,8 @@ class Request:
         self.prefill_pos = 0          # prefill tokens already in the pool
         self.prefill_target = self.prompt_len
         self._prefill_src = self.prompt
+        self.cached_prefix_tokens = 0  # tokens aliased from the prefix
+        # cache at this admission (prefill skips them — the TTFT win)
         self.preemptions = 0
         self.tokens: list = []        # generated token ids (incl. eos)
         self.finished = False
@@ -122,6 +134,7 @@ class Request:
         self.preemptions += 1
         self.slot = None
         self.prefill_pos = 0
+        self.cached_prefix_tokens = 0  # re-admission re-attaches
         if self.tokens:
             self._prefill_src = np.concatenate(
                 [self.prompt, np.asarray(self.tokens, np.int32)])
@@ -201,6 +214,11 @@ class Scheduler:
                     f"pool {pool.block_size}: one demand number must "
                     f"cover every pool")
         self.token_margin = int(token_margin)
+        # requests admitted with their whole prompt cached still owe one
+        # future COW allocation per pool (the capped re-prefill of the
+        # final prompt token writes into the tail shared block); the
+        # dynamic fit check carries the debt until the engine clears it
+        self._cow_debt = {}  # req -> blocks its pending COW may allocate
         self.waiting = deque()
         self.slots = [None] * config.num_slots
         # blocks permanently unavailable to requests (engine scratch)
@@ -224,6 +242,92 @@ class Scheduler:
     def _demand(self, req):
         return self.pool.blocks_needed(
             req.prompt_len + req.max_new_tokens + self.token_margin)
+
+    # -- prefix-cache-aware admission --------------------------------------
+    @property
+    def _prefix_on(self):
+        return getattr(self.pool, "prefix_cache_enabled", False)
+
+    def _all_pools(self):
+        return [self.pool] + self.companion_pools
+
+    def _match_blocks(self, req):
+        """Full blocks EVERY pool can alias for ``req``'s prefill source
+        — the min across pools, so the draft pool attaches in LOCKSTEP
+        with the target pool and the engine's shared per-slot sequence
+        length stays consistent."""
+        if not self._prefix_on:
+            return 0
+        return min(p.prefix_match_stats(req.prefill_src)["matched_blocks"]
+                   for p in self._all_pools())
+
+    def _cow_allowance(self, req, m_blocks):
+        """Blocks ``req``'s pending copy-on-write may still allocate in
+        each pool: 1 when the cached prefix covers the whole prefill
+        source (the engine caps ``prefill_pos`` one token short, and
+        re-prefilling that token COWs the tail shared block), else 0 —
+        every other write lands in a fresh block by construction."""
+        return 1 if (m_blocks and m_blocks * self.pool.block_size
+                     >= req.prefill_target) else 0
+
+    def clear_cow_debt(self, req):
+        """The engine calls this once ``req``'s prefill completes — any
+        COW its admission could trigger has happened (or never will),
+        so the debt stops inflating the dynamic fit check."""
+        self._cow_debt.pop(req, None)
+
+    def _fits(self, req, need):
+        """Would ``req``'s admission keep every pool exhaustion-free in
+        the worst case?
+
+        Cache OFF: the static reservation check (worst-case demand of
+        every in-flight request, pre-reserved) — byte-for-byte the
+        pre-prefix-cache behavior.
+
+        Cache ON: per-pool NOVEL-demand check. Each live request can
+        still allocate at most ``demand - held`` fresh blocks (its
+        table only grows toward its worst case; shared blocks it
+        already maps are in ``held``) plus its pending COW debt; the
+        candidate allocates ``need - matched`` fresh blocks plus its
+        own COW allowance. All of that must fit in what the pool can
+        produce: the free list plus cached-only blocks eviction can
+        reclaim — MINUS the matched evictable blocks this admission is
+        about to pin (attach bumps them to refcount 2)."""
+        if not self._prefix_on:
+            return self.reserved_blocks + need <= self._capacity
+        m = self._match_blocks(req)
+        cow_new = self._cow_allowance(req, m)
+        for p in self._all_pools():
+            growth = sum(
+                max(0, dem - p.held_blocks(r.req_id))
+                for r, dem in self._reservations.items())
+            debt = sum(self._cow_debt.get(r, 0)
+                       for r in self._reservations)
+            pinned = p.prefix_match_stats(
+                req.prefill_src, max_blocks=m)["evictable"]
+            avail = (p.free_blocks + p.evictable_prefix_blocks()
+                     - pinned - self._base_reserved
+                     + p.held_blocks("__scratch__"))
+            if growth + debt + (need - m + cow_new) > avail:
+                return False
+        return True
+
+    def _attach(self, req):
+        """Alias the cached prefix into ``req``'s fresh tables in every
+        pool (same block count everywhere — lockstep) and record how
+        many prompt tokens prefill may now skip."""
+        if not self._prefix_on:
+            return 0
+        m = self._match_blocks(req)
+        cached = 0
+        for p in self._all_pools():
+            cached = p.attach_prefix(req.req_id, req.prefill_src,
+                                     max_blocks=m)
+        req.cached_prefix_tokens = int(cached)
+        allowance = self._cow_allowance(req, m)
+        if allowance:
+            self._cow_debt[req] = allowance
+        return cached
 
     @property
     def reserved_blocks(self):
@@ -253,8 +357,7 @@ class Scheduler:
         pressure signal the preemption policy keys on."""
         if not any(s is None for s in self.slots):
             return False
-        return (self.reserved_blocks + self._demand(req)
-                <= self._capacity)
+        return self._fits(req, self._demand(req))
 
     def try_admit(self):
         """Move waiting requests into free slots while their worst-case
@@ -276,12 +379,13 @@ class Scheduler:
                     f"request {req.req_id}: needs {need} blocks, pool "
                     f"only has {self._capacity - self._base_reserved} "
                     f"usable — raise num_blocks or split the request")
-            if self.reserved_blocks + need > self._capacity:
+            if not self._fits(req, need):
                 break
             self.waiting.remove(req)
             req.slot = free[0]
             self.slots[free[0]] = req
             self._reservations[req] = need
+            self._attach(req)
             # a request with preemptions behind it was admitted before:
             # this admission is the RESUME half of a preempt/resume
             # pair, not new work
@@ -308,6 +412,7 @@ class Scheduler:
         for p in self.companion_pools:
             p.free(req.req_id)
         self._reservations.pop(req, None)
+        self._cow_debt.pop(req, None)
         self.slots[req.slot] = None
         req.begin_resume()
         # head of the deque: the stable scan in next_waiting() puts a
@@ -323,6 +428,7 @@ class Scheduler:
         for p in self.companion_pools:
             p.free(req.req_id)
         self._reservations.pop(req, None)
+        self._cow_debt.pop(req, None)
         if req.slot is not None:
             self.slots[req.slot] = None
             req.slot = None

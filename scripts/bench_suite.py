@@ -12,7 +12,8 @@ sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
 serving_engine | speculative_decode | speculative_serving |
-serving_obs_overhead | slo_overhead | serving_overload
+serving_obs_overhead | slo_overhead | serving_overload |
+shared_prefix
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -990,6 +991,15 @@ def serving_overload():
     return _bench_serving().serving_overload()
 
 
+def shared_prefix():
+    """Prefix-cache acceptance row (ISSUE 9): ragged Poisson arrivals
+    over one common system prompt, prefix_cache=True vs the unshared
+    engine on the same arrival trace — prefill tokens and novel pool
+    residency must scale with unique tokens, streams bit-identical
+    (see scripts/bench_serving.py, artifact BENCH_PREFIX_r11.json)."""
+    return _bench_serving().shared_prefix()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -999,6 +1009,7 @@ CONFIGS = {
     "serving_obs_overhead": serving_obs_overhead,
     "slo_overhead": slo_overhead,
     "serving_overload": serving_overload,
+    "shared_prefix": shared_prefix,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -37,5 +37,14 @@ python -m paddle_tpu.analysis --check --fingerprint
 # continue the stream, drain must flush the flight journals, and the
 # watch dashboard must render the overload line. H106/H107 lint covers
 # serving/{frontend,policy}.py through the repo-wide scan above.
+#
+# Prefix-cache gate (ISSUE 9): `--check --fingerprint` audits
+# `serving_prefix_step` (the prefix_cache=True engine's quantum after
+# a REAL cache hit + copy-on-write: 0 host callbacks, pools donated,
+# same caps as serving_decode_step — the proof the whole
+# content-addressed cache policy is host-side allocator work), and
+# `obs check` runs the prefix smoke: forced hit/COW must fire the
+# serving_prefix_cache_* counters, streams must stay bit-identical to
+# an unshared engine, and the dashboard must render the prefix line.
 python -m paddle_tpu.obs check
 echo "check_graphs: lint + budgets + fingerprints (+obs) all green"

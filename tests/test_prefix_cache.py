@@ -1,0 +1,424 @@
+"""Adversarial tests for content-addressed prefix caching + COW block
+sharing in the paged KV pool (ISSUE 9).
+
+Pool level: forced hash collisions must verify before aliasing, chain
+depth is part of the key, copy-on-write isolates writers at the device
+rows, eviction respects refcounts (LRU, leaf-first, never a live
+holder), the refcount-aware fragmentation stats count a shared block
+once while reducing exactly to the old sums on unshared pools, and a
+100-round seeded ragged churn leaks nothing at refcount granularity.
+
+Engine level: a prefix-cached engine's streams are BIT-IDENTICAL to
+the unshared engine (greedy and fixed-seed sampling, including the
+full-prompt-match requests whose capped re-prefill forces COW),
+admission counts only NOVEL block demand (same-prompt requests run
+concurrently where the unshared engine must serialize), and evicting
+one sharer mid-decode leaves both the survivor and the resumed stream
+bit-exact.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nlp import PagedKVCachePool
+from paddle_tpu.nlp import paged_cache
+from paddle_tpu.serving import ServingEngine
+
+
+def _pool(num_blocks=8, bs=4, prefix=True):
+    return PagedKVCachePool(num_blocks=num_blocks, block_size=bs,
+                            num_kv_heads=2, head_dim=8,
+                            dtype=jnp.float32, prefix_cache=prefix)
+
+
+def _audit(pool):
+    """Refcount-granularity leak oracle: every block's refcount must
+    equal its holder count (tables mapping it + the index's hold), the
+    free list and the held set must partition the pool, and the stats
+    must stay sane."""
+    expect = {}
+    for table in pool._tables.values():
+        for b in table:
+            expect[b] = expect.get(b, 0) + 1
+    for b in pool._cached_blocks:
+        expect[b] = expect.get(b, 0) + 1
+    assert expect == pool._refcounts
+    assert len(pool._free) + len(expect) == pool.num_blocks
+    assert not (set(pool._free) & set(expect))
+    st = pool.fragmentation_stats()
+    assert 0.0 <= st["utilization"] <= 1.0
+    assert st["blocks_in_use"] == len(expect)
+
+
+# ------------------------------------------------------- hash chaining
+def test_chain_depth_is_part_of_the_key():
+    """The SAME block content at different prefix depths must index as
+    distinct entries (the rolling hash chains over the parent), and a
+    prompt whose first block differs matches nothing even though its
+    second block's content is cached at depth 1."""
+    pool = _pool(num_blocks=8, bs=4)
+    rep = np.array([7, 7, 7, 7] * 2, np.int32)  # block A twice
+    pool.ensure("a", 8)
+    assert pool.publish_prefix("a", rep) == 2
+    e0, e1 = pool._match_entries(rep)
+    assert e0.block != e1.block and e0.hash != e1.hash
+    assert e1.parent is e0
+    # depth-0 content alone matches one block, not two
+    assert pool.match_prefix(np.array([7, 7, 7, 7, 1, 2, 3, 4],
+                                      np.int32)) == 4
+    # block A at depth 1 behind a different head: no match at all
+    assert pool.match_prefix(np.array([9, 9, 9, 9, 7, 7, 7, 7],
+                                      np.int32)) == 0
+
+
+def test_forced_hash_collision_never_aliases(monkeypatch):
+    """Break the hash entirely (every block keys to the same bucket):
+    lookups must STILL never alias — bucket entries verify parent
+    identity + the stored token tuple before any share."""
+    monkeypatch.setattr(paged_cache, "_chain_hash",
+                        lambda parent_hash, tokens: 7)
+    pool = _pool(num_blocks=12, bs=4)
+    p1 = np.arange(8, dtype=np.int32)
+    p2 = np.arange(8, 16, dtype=np.int32)
+    pool.ensure("a", 8)
+    pool.ensure("b", 8)
+    assert pool.publish_prefix("a", p1) == 2
+    assert pool.publish_prefix("b", p2) == 2
+    assert len(pool._prefix_buckets) == 1  # all four entries, one bucket
+    got = pool.attach_prefix("c", p2)
+    assert got == 8
+    assert pool._tables["c"] == pool._tables["b"]
+    assert pool._tables["c"] != pool._tables["a"]
+    # content cached under neither chain: verified miss, no alias
+    assert pool.attach_prefix("d", np.full(8, 99, np.int32)) == 0
+    _audit(pool)
+
+
+# ------------------------------------------------------- copy-on-write
+def test_cow_isolates_writers_at_device_rows():
+    """A write into a shared block must land in a FRESH copy: the
+    sharer's (and the index's) block keeps its rows bit-exact, the
+    writer's table swaps to the copy, refcounts rebalance."""
+    pool = _pool(num_blocks=8, bs=4)
+    toks = np.arange(8, dtype=np.int32)
+    pool.ensure("a", 8)
+    k = pool.k_pools[0]
+    for blk in pool._tables["a"]:
+        k = k.at[blk].set(float(blk) + 1.0)
+    pool.k_pools[0] = k
+    pool.publish_prefix("a", toks)
+    assert pool.attach_prefix("b", toks) == 8
+    shared = list(pool._tables["b"])
+    assert shared == pool._tables["a"]
+    before = [np.asarray(pool.k_pools[0][b]) for b in shared]
+    copies = pool.make_writable("b", 4, 8)  # write into block 1 only
+    assert copies == 1
+    assert pool._tables["b"][0] == shared[0]      # untouched: still shared
+    fresh = pool._tables["b"][1]
+    assert fresh != shared[1]
+    # the copy carries the rows; the original is untouched
+    np.testing.assert_array_equal(np.asarray(pool.k_pools[0][fresh]),
+                                  before[1])
+    np.testing.assert_array_equal(np.asarray(pool.k_pools[0][shared[1]]),
+                                  before[1])
+    pool.k_pools[0] = pool.k_pools[0].at[fresh].set(-1.0)
+    np.testing.assert_array_equal(np.asarray(pool.k_pools[0][shared[1]]),
+                                  before[1])
+    assert pool._refcounts[shared[1]] == 2  # a + index (b moved off)
+    assert pool._refcounts[fresh] == 1
+    # exclusively-owned fast path: second write copies nothing
+    assert pool.make_writable("b", 4, 8) == 0
+    assert pool.cow_copies == 1
+    _audit(pool)
+
+
+# ------------------------------------------------------------ eviction
+def test_eviction_respects_refcounts_lru_leaf_first():
+    pool = _pool(num_blocks=8, bs=4)
+    old = np.arange(8, dtype=np.int32)
+    new = np.arange(100, 108, dtype=np.int32)
+    pool.ensure("a", 8)
+    pool.publish_prefix("a", old)
+    pool.ensure("b", 8)
+    pool.publish_prefix("b", new)       # later tick than "a"'s chain
+    # live holders pin everything: nothing is evictable
+    assert pool.evictable_prefix_blocks() == 0
+    assert pool.evict_prefix(8) == 0
+    pool.free("a")
+    pool.free("b")
+    assert pool.evictable_prefix_blocks() == 4
+    # LRU leaf-first: the OLD chain's leaf (depth 1) goes first,
+    # leaving its depth-0 parent cached and the chain walkable
+    assert pool.evict_prefix(1) == 1
+    assert pool.match_prefix(old) == 4
+    assert pool.match_prefix(new) == 8
+    # attaching re-pins: the survivor chain can't be evicted under it
+    pool.attach_prefix("c", new)
+    assert pool.evict_prefix(8) == 1    # only old's depth-0 leaf left
+    assert pool.cached_blocks == 2
+    _audit(pool)
+
+
+def test_allocation_pressure_reclaims_cached_only_blocks():
+    """ensure() on a dry free list must evict cached-only blocks on
+    demand — and must STILL raise exhaustion when live sequences pin
+    the rest."""
+    pool = _pool(num_blocks=4, bs=4)
+    toks = np.arange(8, dtype=np.int32)
+    pool.ensure("a", 8)
+    pool.publish_prefix("a", toks)
+    pool.free("a")                       # 2 cached-only + 2 free
+    assert pool.can_allocate(16)
+    pool.ensure("big", 16)               # needs all 4: evicts the cache
+    assert pool.cached_blocks == 0
+    assert pool.prefix_evictions == 2
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.ensure("more", 4)
+    _audit(pool)
+
+
+def test_clear_prefix_cache_releases_every_hold():
+    pool = _pool(num_blocks=8, bs=4)
+    toks = np.arange(12, dtype=np.int32)
+    pool.ensure("a", 12)
+    pool.publish_prefix("a", toks)
+    pool.free("a")
+    assert pool.cached_blocks == 3
+    assert pool.clear_prefix_cache() == 3
+    assert pool.free_blocks == pool.num_blocks
+    assert not pool._refcounts and not pool._prefix_buckets
+    _audit(pool)
+
+
+# ------------------------------------- refcount-aware fragmentation
+def test_fragmentation_counts_shared_block_once():
+    """Three holders of the same two physical blocks (publisher, index,
+    attacher) must report 2 blocks in use at utilization 1.0 — the
+    per-sequence sum would claim 16 live tokens over 8 slots."""
+    pool = _pool(num_blocks=8, bs=4)
+    toks = np.arange(8, dtype=np.int32)
+    pool.ensure("a", 8)
+    pool.publish_prefix("a", toks)
+    pool.attach_prefix("b", toks)
+    s = pool.fragmentation_stats()
+    assert s["blocks_in_use"] == 2
+    assert s["live_tokens"] == 8
+    assert s["utilization"] == pytest.approx(1.0)
+    assert s["shared_blocks"] == 2
+    assert s["cached_blocks"] == 2
+    # a cached-only block (holders freed) still counts as fully live
+    pool.free("a")
+    pool.free("b")
+    s2 = pool.fragmentation_stats()
+    assert s2["blocks_in_use"] == 2
+    assert s2["utilization"] == pytest.approx(1.0)
+    assert s2["shared_blocks"] == 0
+    _audit(pool)
+
+
+def test_fragmentation_unshared_pool_unchanged():
+    """Regression pin: with the prefix index enabled but no sharing,
+    the refcount-aware stats reduce EXACTLY to the legacy per-sequence
+    sums (same numbers test_serving pins on a plain pool)."""
+    pool = _pool(bs=4)
+    pool.ensure("a", 5)
+    pool.ensure("b", 4)
+    s = pool.fragmentation_stats()
+    assert s["blocks_in_use"] == 3
+    assert s["live_tokens"] == 9
+    assert s["tail_waste_tokens"] == 3
+    assert s["utilization"] == pytest.approx(9 / 12)
+    assert s["shared_blocks"] == 0 and s["cached_blocks"] == 0
+
+
+# ------------------------------------------------------- ragged churn
+def test_pool_ragged_churn_100_rounds_zero_leaks():
+    """100 seeded rounds of ragged admit/attach/publish/COW/trim/free/
+    evict over a tiny token alphabet (so chains really share), with the
+    refcount-granularity audit after EVERY round; teardown must return
+    the pool to pristine."""
+    rng = np.random.RandomState(42)
+    pool = _pool(num_blocks=16, bs=4)
+    live, counter = {}, 0
+    for _ in range(100):
+        op = rng.rand()
+        if op < 0.55 and len(live) < 6:
+            sid = f"s{counter}"
+            counter += 1
+            toks = rng.randint(0, 3,
+                               rng.randint(1, 21)).astype(np.int32)
+            try:
+                matched = pool.attach_prefix(sid, toks)
+                pool.ensure(sid, len(toks))
+                if rng.rand() < 0.25:
+                    # rewrite-from-scratch: COW every shared block
+                    pool.make_writable(sid, 0, len(toks))
+                else:
+                    pool.make_writable(sid, matched, len(toks))
+                pool.publish_prefix(sid, toks)
+                live[sid] = toks
+            except RuntimeError:
+                pool.free(sid)  # exhausted mid-growth: roll back
+                if live:
+                    victim = list(live)[rng.randint(len(live))]
+                    live.pop(victim)
+                    pool.free(victim)
+        elif op < 0.75 and live:
+            victim = list(live)[rng.randint(len(live))]
+            live.pop(victim)
+            pool.free(victim)
+        elif op < 0.85 and live:
+            sid = list(live)[rng.randint(len(live))]
+            keep = rng.randint(0, len(live[sid]) + 1)
+            pool.trim(sid, keep)
+        else:
+            pool.evict_prefix(rng.randint(0, 3))
+        _audit(pool)
+    assert pool.prefix_hits > 0 and pool.cow_copies > 0
+    assert pool.prefix_evictions > 0
+    for sid in list(live):
+        pool.free(sid)
+    pool.clear_prefix_cache()
+    assert pool.free_blocks == pool.num_blocks
+    assert not pool._refcounts and not pool._tables
+    assert not pool._prefix_buckets and not pool._cached_blocks
+
+
+# ------------------------------------------------------- engine parity
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _shared_prompts(cfg):
+    """A common 8-token system prompt (2 full blocks at bs=4) + unique
+    tails; the LAST prompt is the bare system prompt — its full-chain
+    hit re-prefills one capped token into a shared block, the designed
+    COW trigger."""
+    rng = np.random.RandomState(3)
+    sys_p = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+    tails = [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+             for n in (3, 5, 1)]
+    return [np.concatenate([sys_p, t]) for t in tails] + [sys_p.copy()]
+
+
+def _run_engine(model, prompts, max_new, prefix, seeds=None, **kw):
+    eng = ServingEngine(model, num_slots=2, block_size=4,
+                        prefill_chunk=4, decode_quantum=3,
+                        prefix_cache=prefix, **kw)
+    for i, (p, mn) in enumerate(zip(prompts, max_new)):
+        eng.submit(p, max_new_tokens=mn, req_id=f"r{i}",
+                   seed=seeds[i] if seeds else 0)
+    done = eng.run()
+    return eng, {str(r.req_id): list(r.tokens) for r in done}
+
+
+def test_engine_prefix_greedy_parity(tiny_model):
+    """Greedy streams bit-identical to the unshared engine, with real
+    hits, at least one COW, and strictly fewer prefill tokens; the
+    pool ends clean (scratch + cache only)."""
+    cfg, model = tiny_model
+    prompts = _shared_prompts(cfg)
+    max_new = [5, 4, 6, 4]
+    base, want = _run_engine(model, prompts, max_new, prefix=False)
+    pref, got = _run_engine(model, prompts, max_new, prefix=True)
+    assert got == want
+    pc = pref.pool.prefix_cache_stats()
+    assert pc["hits"] > 0
+    assert pc["cow_copies"] >= 1          # the bare-prompt request
+    assert (pref.stats["prefill_tokens"]
+            < base.stats["prefill_tokens"])
+    assert "prefix_cache" in pref.engine_stats()
+    # retirement released every request hold: scratch + cache remain
+    assert pref.pool.blocks_in_use == 1 + pref.pool.cached_blocks
+    _audit(pref.pool)
+
+
+def test_engine_admission_counts_novel_demand_only(tiny_model):
+    """Two identical prompts on a pool that cannot hold two UNSHARED
+    copies: the unshared engine must serialize them, the prefix engine
+    admits both at once (the second request's demand is its novel
+    blocks) — streams identical either way."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+
+    def run(prefix):
+        eng = ServingEngine(model, num_slots=2, block_size=4,
+                            num_blocks=6, max_context=16,
+                            prefill_chunk=4, decode_quantum=3,
+                            prefix_cache=prefix)
+        a = eng.submit(prompt, max_new_tokens=4, req_id="a")
+        # publish the prompt chain, then offer the twin
+        while not a.tokens:
+            eng.step()
+        b = eng.submit(prompt.copy(), max_new_tokens=4, req_id="b")
+        overlap = False
+        while eng.has_work:
+            eng.step()
+            overlap = overlap or (a.slot is not None
+                                  and b.slot is not None)
+        return overlap, {"a": list(a.tokens), "b": list(b.tokens)}
+
+    overlap_u, streams_u = run(False)
+    overlap_p, streams_p = run(True)
+    assert streams_p == streams_u
+    assert not overlap_u   # 1 + 3 + 3 reserved blocks > 6: serialized
+    assert overlap_p       # novel demand of the twin fits alongside
+
+
+@pytest.mark.slow
+def test_engine_prefix_sampling_parity(tiny_model):
+    """Fixed-seed sampling: the cached engine must replay the unshared
+    engine's streams exactly (per-request seeds, shared prefix +
+    full-match COW requests included)."""
+    cfg, model = tiny_model
+    prompts = _shared_prompts(cfg)
+    max_new = [5, 4, 6, 4]
+    seeds = [101, 202, 303, 404]
+    base, want = _run_engine(model, prompts, max_new, prefix=False,
+                             seeds=seeds, decode_strategy="sampling",
+                             temperature=0.8)
+    pref, got = _run_engine(model, prompts, max_new, prefix=True,
+                            seeds=seeds, decode_strategy="sampling",
+                            temperature=0.8)
+    assert got == want
+    assert pref.pool.prefix_cache_stats()["hits"] > 0
+
+
+@pytest.mark.slow
+def test_engine_cow_under_preemption(tiny_model):
+    """Evict one of two sharers mid-decode: the survivor keeps decoding
+    over the still-shared blocks, the victim resumes by re-prefill
+    (re-attaching the cache), and BOTH streams stay bit-exact vs an
+    undisturbed unshared run."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(9)
+    sys_p = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+    prompts = [np.concatenate([sys_p, rng.randint(
+        1, cfg.vocab_size, n).astype(np.int32)]) for n in (2, 3)]
+    max_new = [8, 8]
+    _, want = _run_engine(model, prompts, max_new, prefix=False)
+
+    eng = ServingEngine(model, num_slots=2, block_size=4,
+                        prefill_chunk=4, decode_quantum=3,
+                        prefix_cache=True)
+    a = eng.submit(prompts[0], max_new_tokens=8, req_id="r0")
+    b = eng.submit(prompts[1], max_new_tokens=8, req_id="r1")
+    while len(a.tokens) < 2 or len(b.tokens) < 2:
+        eng.step()
+    assert not a.finished and not b.finished
+    eng.preempt(a)  # refcount-safe: b and the index keep the prefix
+    assert a.slot is None
+    done = eng.run()
+    got = {str(r.req_id): list(r.tokens) for r in done}
+    assert got == want
+    assert eng.scheduler.preempted_total == 1
+    assert eng.pool.prefix_cache_stats()["hits"] > 0
+    _audit(eng.pool)
