@@ -30,6 +30,12 @@ Four layers:
    scripts/`` flags tracer hazards in the framework source itself
    (host syncs in jit-reachable code, Python control flow on traced
    values, np.* on tensors, mutable default args).
+5. **Perf sentinel**: :mod:`.perf_budget` — declarative
+   :class:`PerfBudget` floors/ceilings (explicit noise bands) over the
+   checked-in ``BENCH_*.json`` trajectory, a deterministic
+   ``BENCH_INDEX.json`` (:func:`build_index` / :func:`compare_index`
+   staleness diffs) and the :func:`check_perf` gate run pre-merge by
+   ``scripts/check_perf.sh`` via ``scripts/validate_bench.py``.
 
 CLI: ``python -m paddle_tpu.analysis`` audits the registered recipes
 (``--check`` enforces budgets, ``--fingerprint`` compares goldens,
@@ -59,6 +65,10 @@ from .budget import (
 from .recipes import RECIPES, Recipe, build as build_recipe, \
     run as run_recipe
 from .lint import LintViolation, lint_paths, lint_source
+from .perf_budget import (
+    INDEX_VERSION, PerfBudget, PerfBudgetViolation, build_index,
+    check_perf, compare_index, default_perf_budgets, normalize_artifact,
+)
 
 __all__ = [
     # ir
@@ -81,4 +91,8 @@ __all__ = [
     "RECIPES", "Recipe", "build_recipe", "run_recipe",
     # linter
     "LintViolation", "lint_paths", "lint_source",
+    # perf sentinel
+    "INDEX_VERSION", "PerfBudget", "PerfBudgetViolation", "build_index",
+    "check_perf", "compare_index", "default_perf_budgets",
+    "normalize_artifact",
 ]

@@ -1,0 +1,381 @@
+"""Perf budgets: the RUNTIME twin of :mod:`.budget`. The graph gate
+(budgets + golden fingerprints) catches structural drift; nothing
+caught a bench ratio quietly regressing — the repo's perf claims live
+in BENCH_*.json artifacts that no check read. This module turns that
+trajectory into a merge gate::
+
+    from paddle_tpu.analysis.perf_budget import (
+        PerfBudget, build_index, check_perf, default_perf_budgets)
+    index = build_index(glob.glob("BENCH_*.json"))
+    check_perf(index, default_perf_budgets())   # raises on regression
+
+Three pieces, all stdlib (nothing here imports jax — the sentinel must
+run in a checkout without warming a backend):
+
+1. **Normalization**: the repo's artifacts come in three shapes —
+   *driver* dumps (``BENCH_r0X.json`` / ``MULTICHIP_r0X.json``:
+   ``rc``/``tail`` of a subprocess), *flat* single-row benches
+   (``{"metric": ..., "value": ...}``) and *rows-style* benches
+   (``{"rows": [{"metric": ...}, ...]}``). :func:`normalize_artifact`
+   folds all three into one schema (``{"artifact", "kind", "rows"}``,
+   scalar fields only) and raises ``ValueError`` naming the offending
+   file/field on drift, so a malformed artifact fails the gate before
+   a budget ever reads it.
+2. **PerfBudget**: declarative ratio floors/ceilings with an EXPLICIT
+   noise band, mirroring :class:`.budget.Budget` (``None`` =
+   unchecked, unknown field = ``TypeError``, violations collect into
+   ONE :class:`PerfBudgetViolation`). The band is part of the
+   declaration — loosening it is a reviewable diff, not a silent
+   retune (see README "performance sentinel" for the honest-loosening
+   protocol).
+3. **The index**: :func:`build_index` renders every artifact plus the
+   guarded-budget declarations into ``BENCH_INDEX.json`` — a
+   deterministic, timestamp-free view the gate regenerates and
+   compares, so a new artifact that never got indexed (or a doctored
+   one) is schema drift, not an invisible hole.
+
+Every measured value in the stock budgets is a CPU-smoke RATIO
+(methodology + caveat centralized in BENCH_NOTES.md): ratios of two
+arms measured in the same process survive host-speed variance that
+absolute tok/s does not, which is what makes a floor meaningful off
+TPU at all.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = [
+    "INDEX_VERSION", "PerfBudget", "PerfBudgetViolation",
+    "normalize_artifact", "build_index", "compare_index", "check_perf",
+    "default_perf_budgets",
+]
+
+INDEX_VERSION = 1
+
+_PERF_FIELDS = ("field", "floor", "ceiling", "noise_frac", "reason")
+
+# scalar row fields survive into the index; nested arm dumps and prose
+# stay in the source artifact (the index is the machine-read view)
+_SCALARS = (int, float, bool, str)
+
+
+class PerfBudget:
+    """One guarded ratio in one artifact. ``None`` caps are unchecked;
+    at least one of ``floor``/``ceiling`` must be set.
+
+    Args:
+        name: short human handle (shows up in violation lines).
+        artifact: file name the guarded row lives in
+            (e.g. ``"BENCH_SPEC_r07.json"``).
+        metric: the row's ``metric`` field value to match.
+        field: which scalar field of that row to guard (default
+            ``"value"`` — rows may carry secondary ratios, e.g.
+            ``quantum_speedup_vs_batch1``).
+        floor / ceiling: the claim. A measured value below
+            ``floor * (1 - noise_frac)`` or above
+            ``ceiling * (1 + noise_frac)`` is a violation.
+        noise_frac: explicit relative noise band (0.1 = 10%) — the
+            honest statement of how much CPU-smoke jitter the claim
+            tolerates before it counts as a regression.
+        reason: one line on where the bound comes from (indexed, so
+            the trajectory documents itself).
+    """
+
+    def __init__(self, name, artifact, metric, **caps):
+        unknown = set(caps) - set(_PERF_FIELDS)
+        if unknown:
+            raise TypeError(
+                f"unknown perf-budget field(s) {sorted(unknown)}; "
+                f"valid: {_PERF_FIELDS}")
+        self.name = str(name)
+        self.artifact = str(artifact)
+        self.metric = str(metric)
+        self.field = str(caps.get("field", "value"))
+        self.floor = caps.get("floor")
+        self.ceiling = caps.get("ceiling")
+        self.noise_frac = float(caps.get("noise_frac", 0.0))
+        self.reason = str(caps.get("reason", ""))
+        if self.floor is None and self.ceiling is None:
+            raise TypeError(
+                f"perf budget {self.name!r}: set floor and/or ceiling")
+        if not 0.0 <= self.noise_frac < 1.0:
+            raise TypeError(
+                f"perf budget {self.name!r}: noise_frac must be in "
+                f"[0, 1), got {self.noise_frac}")
+
+    @property
+    def effective_floor(self):
+        return (None if self.floor is None
+                else self.floor * (1.0 - self.noise_frac))
+
+    @property
+    def effective_ceiling(self):
+        return (None if self.ceiling is None
+                else self.ceiling * (1.0 + self.noise_frac))
+
+    def to_dict(self):
+        """Deterministic declaration record for BENCH_INDEX.json."""
+        return {
+            "name": self.name, "artifact": self.artifact,
+            "metric": self.metric, "field": self.field,
+            "floor": self.floor, "ceiling": self.ceiling,
+            "noise_frac": self.noise_frac, "reason": self.reason,
+        }
+
+    def check_row(self, row):
+        """Violation lines for one normalized row (empty = ok) — the
+        field-level diff: budget vs measured vs band, in one line."""
+        v = []
+        got = row.get(self.field)
+        if not isinstance(got, (int, float)) or isinstance(got, bool):
+            v.append(
+                f"{self.artifact} · {self.metric}: field "
+                f"{self.field!r} missing or non-numeric "
+                f"(got {got!r}) — schema drift")
+            return v
+        ef, ec = self.effective_floor, self.effective_ceiling
+        if ef is not None and got < ef:
+            v.append(
+                f"{self.artifact} · {self.metric}.{self.field} = "
+                f"{got:g} < floor {self.floor:g} "
+                f"(noise band {self.noise_frac:.0%} -> {ef:g}) "
+                f"[{self.name}]")
+        if ec is not None and got > ec:
+            v.append(
+                f"{self.artifact} · {self.metric}.{self.field} = "
+                f"{got:g} > ceiling {self.ceiling:g} "
+                f"(noise band {self.noise_frac:.0%} -> {ec:g}) "
+                f"[{self.name}]")
+        return v
+
+    def __repr__(self):
+        bound = []
+        if self.floor is not None:
+            bound.append(f">= {self.floor:g}")
+        if self.ceiling is not None:
+            bound.append(f"<= {self.ceiling:g}")
+        return (f"PerfBudget({self.name!r}, {self.artifact} · "
+                f"{self.metric}.{self.field} {' and '.join(bound)} "
+                f"±{self.noise_frac:.0%})")
+
+
+class PerfBudgetViolation(AssertionError):
+    """One or more perf budgets violated (or schema drift);
+    ``violations`` is the list of field-level diff lines."""
+
+    def __init__(self, violations):
+        self.violations = list(violations)
+        super().__init__(
+            f"perf sentinel: {len(self.violations)} violation(s)\n  - "
+            + "\n  - ".join(self.violations))
+
+
+# ------------------------------------------------------ normalization
+def _scalar_row(d, ctx):
+    if not isinstance(d, dict):
+        raise ValueError(f"{ctx}: row must be a dict, got "
+                         f"{type(d).__name__}")
+    if not isinstance(d.get("metric"), str) or not d["metric"]:
+        raise ValueError(f"{ctx}: missing non-empty 'metric' field")
+    return {k: v for k, v in sorted(d.items())
+            if isinstance(v, _SCALARS) and not k.startswith("_")}
+
+
+def normalize_artifact(doc, name):
+    """Fold one artifact (parsed JSON) into the index schema::
+
+        {"artifact": <file>, "kind": "bench"|"driver",
+         "rows": [{scalar fields...}, ...]}   # driver: rc/ok summary
+
+    Raises ``ValueError`` naming the file and field on any shape the
+    repo's three artifact families don't produce — schema drift fails
+    the gate loudly instead of indexing garbage.
+    """
+    ctx = str(name)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{ctx}: artifact must be a JSON object, got "
+                         f"{type(doc).__name__}")
+    if "rows" in doc:
+        if not isinstance(doc["rows"], list) or not doc["rows"]:
+            raise ValueError(f"{ctx}: 'rows' must be a non-empty list")
+        rows = [_scalar_row(r, f"{ctx}: rows[{i}]")
+                for i, r in enumerate(doc["rows"])]
+        return {"artifact": ctx, "kind": "bench", "rows": rows}
+    if "metric" in doc:
+        return {"artifact": ctx, "kind": "bench",
+                "rows": [_scalar_row(doc, ctx)]}
+    if "rc" in doc:  # driver dump: a subprocess's exit + tail
+        rc = doc["rc"]
+        if not isinstance(rc, int):
+            raise ValueError(f"{ctx}: driver 'rc' must be an int, got "
+                             f"{rc!r}")
+        row = {"metric": "driver_exit", "rc": rc}
+        for k in ("n", "n_devices", "ok", "skipped"):
+            if isinstance(doc.get(k), _SCALARS):
+                row[k] = doc[k]
+        return {"artifact": ctx, "kind": "driver", "rows": [row]}
+    raise ValueError(
+        f"{ctx}: unrecognized artifact shape — expected 'rows' "
+        f"(rows-style bench), 'metric' (flat bench) or 'rc' (driver "
+        f"dump); top-level keys: {sorted(doc)[:8]}")
+
+
+# -------------------------------------------------------------- index
+def build_index(paths, budgets=None):
+    """Normalize every artifact at ``paths`` into the deterministic
+    BENCH_INDEX.json document (sorted by file name, no timestamps —
+    regenerating from the same artifacts is byte-identical)."""
+    artifacts = []
+    for p in sorted(paths, key=os.path.basename):
+        base = os.path.basename(p)
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ValueError(f"{base}: unreadable artifact ({e})")
+        artifacts.append(normalize_artifact(doc, base))
+    return {
+        "version": INDEX_VERSION,
+        "artifacts": artifacts,
+        "guarded": [b.to_dict() for b in (budgets or [])],
+    }
+
+
+def compare_index(fresh, checked_in):
+    """Field-level diff lines between a regenerated index and the
+    checked-in one (empty = in sync). Staleness is a gate failure: an
+    artifact changed (or a budget moved) without re-running
+    ``scripts/validate_bench.py --update``."""
+    diffs = []
+    if checked_in.get("version") != fresh["version"]:
+        diffs.append(
+            f"index version {checked_in.get('version')!r} != "
+            f"{fresh['version']} — regenerate")
+    old = {a["artifact"]: a for a in checked_in.get("artifacts", [])}
+    new = {a["artifact"]: a for a in fresh["artifacts"]}
+    for name in sorted(set(old) - set(new)):
+        diffs.append(f"{name}: indexed but artifact file is gone")
+    for name in sorted(set(new) - set(old)):
+        diffs.append(f"{name}: artifact on disk but not indexed")
+    for name in sorted(set(new) & set(old)):
+        if old[name] != new[name]:
+            diffs.append(_row_diff(name, old[name], new[name]))
+    if checked_in.get("guarded") != fresh["guarded"]:
+        diffs.append("guarded budget declarations drifted — "
+                     "regenerate the index")
+    return diffs
+
+
+def _row_diff(name, old, new):
+    """One line naming the first differing row/field."""
+    o_rows, n_rows = old.get("rows", []), new.get("rows", [])
+    if len(o_rows) != len(n_rows):
+        return (f"{name}: row count {len(o_rows)} -> {len(n_rows)} — "
+                f"stale index")
+    for i, (o, n) in enumerate(zip(o_rows, n_rows)):
+        for k in sorted(set(o) | set(n)):
+            if o.get(k) != n.get(k):
+                return (f"{name}: rows[{i}].{k} indexed as "
+                        f"{o.get(k)!r} but artifact has "
+                        f"{n.get(k)!r} — stale index")
+    return f"{name}: indexed entry differs — stale index"
+
+
+# --------------------------------------------------------------- gate
+def check_perf(index, budgets):
+    """Evaluate ``budgets`` over a built/loaded index; returns the
+    per-budget status lines on success, raises
+    :class:`PerfBudgetViolation` with every field-level diff
+    otherwise. A budget whose artifact/metric is absent is a violation
+    (schema drift), not a skip — a deleted artifact must delete its
+    budget in the same diff."""
+    by_name = {a["artifact"]: a for a in index.get("artifacts", [])}
+    ok_lines, violations = [], []
+    for b in budgets:
+        art = by_name.get(b.artifact)
+        if art is None:
+            violations.append(
+                f"{b.artifact}: artifact missing from index "
+                f"(budget {b.name!r} guards it)")
+            continue
+        rows = [r for r in art["rows"] if r.get("metric") == b.metric]
+        if not rows:
+            violations.append(
+                f"{b.artifact}: no row with metric {b.metric!r} "
+                f"(budget {b.name!r}) — schema drift; metrics present: "
+                f"{sorted(r.get('metric') for r in art['rows'])}")
+            continue
+        for row in rows:
+            v = b.check_row(row)
+            if v:
+                violations.extend(v)
+            else:
+                got = row[b.field]
+                bound = (f">= {b.floor:g}" if b.floor is not None
+                         else f"<= {b.ceiling:g}")
+                ok_lines.append(
+                    f"ok  {b.name}: {b.metric}.{b.field} = {got:g} "
+                    f"({bound} ±{b.noise_frac:.0%})")
+    if violations:
+        raise PerfBudgetViolation(violations)
+    return ok_lines
+
+
+def default_perf_budgets():
+    """The repo's guarded perf claims — every ratio a PR has cited as
+    a win, with the band it was observed to wobble in on the CPU smoke
+    (BENCH_NOTES.md carries the raw trajectories). Driver artifacts
+    (BENCH_r0X/MULTICHIP_r0X) are history, not claims: they get schema
+    validation + indexing only — MULTICHIP_r02 honestly recorded a
+    libtpu-mismatch failure (rc=1) and a gate must not demand history
+    be rewritten."""
+    return [
+        PerfBudget(
+            "spec-serving-speedup", "BENCH_SPEC_r07.json",
+            "speculative_serving_speedup_vs_plain_quantum_cpu_smoke",
+            floor=1.1, noise_frac=0.05,
+            reason="one-dispatch spec round must beat the plain "
+                   "quantum (observed 1.23x; claim floor 1.1x)"),
+        PerfBudget(
+            "shed-bounds-p95-ttft", "BENCH_FRONTDOOR_r10.json",
+            "serving_overload_noshed_over_shed_p95_ttft_cpu_smoke",
+            floor=1.5, noise_frac=0.1,
+            reason="under 3x overload the shedding arm must bound p95 "
+                   "TTFT vs no-shed (observed 2.2x)"),
+        PerfBudget(
+            "prefix-prefill-savings", "BENCH_PREFIX_r11.json",
+            "serving_prefix_unshared_over_shared_prefill_tokens_"
+            "cpu_smoke",
+            floor=2.0, noise_frac=0.0,
+            reason="shared-system-prompt arm must prefill O(unique "
+                   "tokens): token RATIO is deterministic on the "
+                   "fixed arrival trace (observed 3.14x), so no band"),
+        PerfBudget(
+            "obs-overhead", "BENCH_OBS_r08.json",
+            "serving_obs_overhead_pct_cpu_smoke",
+            ceiling=3.0, noise_frac=0.0,
+            reason="full metrics+tracing vs obs='off' (<3% bar; "
+                   "observed -1.7% i.e. in the noise)"),
+        PerfBudget(
+            "slo-overhead", "BENCH_SLO_r09.json",
+            "serving_slo_overhead_pct_cpu_smoke",
+            ceiling=3.0, noise_frac=0.0,
+            reason="per-dispatch health polling + flight journaling "
+                   "vs obs='off' (<3% bar; observed 0.6%)"),
+        PerfBudget(
+            "attribution-overhead", "BENCH_ATTR_r12.json",
+            "serving_attribution_overhead_pct_cpu_smoke",
+            ceiling=3.0, noise_frac=0.0,
+            reason="live cost ledger vs a no-op ledger stand-in on "
+                   "the same instrumented engine (<3% bar; observed "
+                   "1.5%) — the attribution layer prices itself"),
+        PerfBudget(
+            "quantum-vs-batch1", "BENCH_SERVING_r06.json",
+            "serving_engine_ragged_tokens_per_sec_cpu_smoke",
+            field="quantum_speedup_vs_batch1",
+            floor=1.25, noise_frac=0.1,
+            reason="the jitted decode quantum must beat sequential "
+                   "batch-1 generate (observed 1.43-1.64x across "
+                   "rounds; floor under the band's low edge)"),
+    ]

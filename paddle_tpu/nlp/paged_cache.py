@@ -111,22 +111,28 @@ class PagedKVCachePool:
     def _alloc_block(self):
         """Pop one free block, reclaiming cached-only prefix blocks
         (LRU) when the free list runs dry — eviction under pressure
-        respects refcounts: only an index-sole-holder block is taken."""
+        respects refcounts: only an index-sole-holder block is taken.
+
+        Blocks are born TRACKED: the refcount entry is written here,
+        before the caller sees the id, so a stats snapshot taken
+        mid-operation (e.g. during a COW device copy, which allocates
+        and then copies layer by layer) can never observe an
+        allocated-but-unaccounted block."""
         if not self._free:
             self.evict_prefix(1)
         if not self._free:
             raise RuntimeError(
                 f"KV pool exhausted ({self.num_blocks} blocks)")
-        return self._free.pop()
+        blk = self._free.pop()
+        self._refcounts[blk] = 1
+        return blk
 
     def ensure(self, seq_id, new_total_tokens):
         """Grow ``seq_id``'s block table to cover ``new_total_tokens``."""
         table = self._tables.setdefault(seq_id, [])
         need = -(-int(new_total_tokens) // self.block_size)
         while len(table) < need:
-            blk = self._alloc_block()
-            self._refcounts[blk] = 1
-            table.append(blk)
+            table.append(self._alloc_block())
         self._lens[seq_id] = max(self._lens.get(seq_id, 0),
                                  int(new_total_tokens))
         self._peak_blocks = max(self._peak_blocks, self.blocks_in_use)
@@ -307,13 +313,12 @@ class PagedKVCachePool:
             blk = table[j]
             if self._refcounts.get(blk, 1) <= 1:
                 continue
-            fresh = self._alloc_block()
+            fresh = self._alloc_block()  # born refcounted
             for i in range(self.num_layers):
                 self.k_pools[i] = self.k_pools[i].at[fresh].set(
                     self.k_pools[i][blk])
                 self.v_pools[i] = self.v_pools[i].at[fresh].set(
                     self.v_pools[i][blk])
-            self._refcounts[fresh] = 1
             table[j] = fresh
             self._release([blk])
             copies += 1
@@ -377,10 +382,38 @@ class PagedKVCachePool:
                 dropped += 1
         return dropped
 
+    def _check_accounting(self):
+        """Hard invariants tying the three ownership structures
+        together (free list / refcount map / tables + prefix index):
+        every non-free block is refcounted exactly once in the map, no
+        block is simultaneously free and held, and every block a table
+        or the index maps is tracked. Drift means a snapshot would
+        double-count an in-flight block (the COW allocate-then-copy
+        window) or hide a leak, so the stats methods raise instead of
+        publishing numbers built on corrupt accounting."""
+        held = set(self._refcounts)
+        if len(held) != self.blocks_in_use:
+            raise RuntimeError(
+                f"pool accounting drift: {self.blocks_in_use} blocks "
+                f"out of the free list but {len(held)} refcounted")
+        stale = held & set(self._free)
+        if stale:
+            raise RuntimeError(
+                f"blocks {sorted(stale)} are both free and refcounted")
+        mapped = set(self._cached_blocks)
+        for table in self._tables.values():
+            mapped.update(table)
+        untracked = mapped - held
+        if untracked:
+            raise RuntimeError(
+                f"mapped blocks {sorted(untracked)} missing from the "
+                f"refcount map")
+
     def prefix_cache_stats(self):
         """Monotonic counters + live index occupancy (the obs layer
         syncs the counters into the metrics registry at step
         boundaries)."""
+        self._check_accounting()
         return {
             "hits": self.prefix_hits,
             "misses": self.prefix_misses,
@@ -476,6 +509,7 @@ class PagedKVCachePool:
         lengths would claim utilization > 1 on a shared pool. For an
         unshared pool this reduces exactly to the old per-sequence
         sum."""
+        self._check_accounting()
         bs = self.block_size
         coverage: dict = {}
         for s, table in self._tables.items():

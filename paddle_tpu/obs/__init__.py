@@ -29,8 +29,13 @@ Layout:
   (SLO state + status code), ``/slo``, ``/snapshot``, ``/anomalies``;
   plus the ``watch`` terminal-dashboard renderer.
 - :mod:`.flight` — :class:`FlightRecorder`: bounded per-request
-  lifecycle journals with dump-on-anomaly (SLO threshold crossings)
-  to schema-validated JSONL.
+  lifecycle journals with dump-on-anomaly (SLO threshold crossings,
+  recompute-waste spikes) to schema-validated JSONL.
+- :mod:`.attribution` — :class:`CostLedger`: per-token cost
+  attribution over the same boundaries (emitted tokens + dispatch
+  walls by phase: prefill / decode / spec_verify /
+  preempt_recompute), useful-token-fraction, prefix prefill savings
+  and serving-MFU gauges; ``engine.attribution()`` is its report.
 
 The hard invariant, enforced by the golden-fingerprint gate: every
 hook runs on the host at a quantum/step boundary — the jitted decode
@@ -63,6 +68,9 @@ from .slo import (  # noqa: F401
 from .flight import (  # noqa: F401
     FlightRecorder, load_flight_records, validate_flight_records,
 )
+from .attribution import (  # noqa: F401
+    CostLedger, decode_flops_per_token,
+)
 from .export import MetricsExporter, render_dashboard  # noqa: F401
 
 __all__ = [
@@ -73,5 +81,6 @@ __all__ = [
     "HealthState", "OK", "WARN", "CRITICAL", "state_of", "worst_state",
     "SLO", "SLOSet", "default_serving_slos",
     "FlightRecorder", "validate_flight_records", "load_flight_records",
+    "CostLedger", "decode_flops_per_token",
     "MetricsExporter", "render_dashboard",
 ]

@@ -43,7 +43,10 @@ must flush the flight journals, and the dashboard must render the
 overload line), and the PREFIX-CACHE smoke (ISSUE 9: a forced cache
 hit + copy-on-write must fire the prefix counters, keep the streams
 bit-identical to an unshared engine, and render the dashboard's
-prefix line). Exit non-zero on drift.
+prefix line), and the ATTRIBUTION smoke (ISSUE 10: the cost ledger
+must conserve — phase token buckets sum to the emitted-token counter
+token-for-token, and per-phase seconds sum to the measured quantum
+walls within float tolerance). Exit non-zero on drift.
 """
 from __future__ import annotations
 
@@ -415,6 +418,62 @@ def _check_prefix_smoke():
           f"to the unshared engine")
 
 
+def _check_attribution_smoke():
+    """The cost-ledger smoke (ISSUE 10): drive the demo engine through
+    its speculative arm and assert the ledger is CONSERVATIVE — every
+    emitted token lands in exactly one phase bucket (ledger totals ==
+    the legacy registry counters token-for-token), prefill work
+    decomposes into novel + recompute, spec-verify waste equals
+    proposed − accepted, and the per-phase wall seconds sum back to
+    the measured quantum walls within float tolerance."""
+    engine = _demo_engine(spec=True)
+    reg = engine.obs.registry
+    ledger = engine.obs.ledger
+
+    emitted = ledger.emitted_tokens()
+    total_emitted = reg.get("serving_tokens_emitted_total").value()
+    if sum(emitted.values()) != total_emitted:
+        raise AssertionError(
+            f"ledger lost tokens: phase buckets {emitted} sum to "
+            f"{sum(emitted.values())}, engine emitted {total_emitted}")
+    work = ledger.prefill_work()
+    prefill_total = reg.get("serving_prefill_tokens_total").value()
+    if work["novel"] + work["recompute"] != prefill_total:
+        raise AssertionError(
+            f"prefill work {work} does not decompose the legacy "
+            f"counter {prefill_total}")
+    proposed = reg.get("serving_spec_proposed_total").value()
+    accepted = reg.get("serving_spec_accepted_total").value()
+    if proposed <= 0 or emitted["spec_verify"] <= 0:
+        raise AssertionError(
+            f"spec arm never exercised: proposed={proposed} "
+            f"spec_verify emitted={emitted['spec_verify']}")
+    rejected = ledger.waste_tokens()["spec_rejected"]
+    if rejected != proposed - accepted:
+        raise AssertionError(
+            f"spec waste drifted: ledger rejected={rejected}, "
+            f"engine proposed-accepted={proposed - accepted}")
+    hist = reg.get("serving_quantum_seconds")
+    wall = sum(hist.sum(kind=k) for k in ("mixed", "decode",
+                                          "spec_round"))
+    attributed = sum(ledger.phase_seconds().values())
+    if abs(attributed - wall) > 1e-6 * max(1.0, wall):
+        raise AssertionError(
+            f"phase seconds {attributed:.9f} do not sum to measured "
+            f"quantum wall {wall:.9f}")
+    rep = engine.attribution()
+    if not 0.0 < rep["useful_token_fraction"] <= 1.0:
+        raise AssertionError(
+            f"useful-token fraction out of range: {rep}")
+    if rep["mfu"]["flops_per_token"] <= 0:
+        raise AssertionError(
+            f"ledger never configured with model FLOPs: {rep['mfu']}")
+    print(f"attribution smoke: {int(total_emitted)} tokens conserved "
+          f"across {emitted}, useful="
+          f"{rep['useful_token_fraction']:.3f}, "
+          f"{attributed:.3f}s attributed == quantum wall")
+
+
 def _cmd_check(args):
     """Instrumented-fingerprint gate: the serving recipes construct
     their engines with full observability ON (analysis/recipes.py);
@@ -467,6 +526,11 @@ def _cmd_check(args):
     except (AssertionError, ValueError) as e:
         failed = True
         print(f"prefix smoke: FAIL — {e}", file=sys.stderr)
+    try:
+        _check_attribution_smoke()
+    except (AssertionError, ValueError, KeyError) as e:
+        failed = True
+        print(f"attribution smoke: FAIL — {e}", file=sys.stderr)
     if failed:
         return 1
     print("obs check: instrumentation-enabled fingerprints unchanged")

@@ -289,6 +289,30 @@ def render_dashboard(snapshot, report=None, width=62):
         lines.append(
             f" recompute {recomputed:>6.0f} cached tokens dropped by "
             f"preemption (re-prefilled on resume)")
+    # cost-ledger lines (obs/attribution.py) — only once the ledger
+    # has attributed something, so pre-ledger snapshots render as
+    # before
+    attr_emitted = sum(
+        g("serving_attr_tokens_total", phase=p)
+        for p in ("prefill", "decode", "spec_verify"))
+    if attr_emitted:
+        lines.append(
+            f" attrib    useful "
+            f"{g('serving_useful_token_fraction'):6.1%}"
+            f"  recomputed "
+            f"{g('serving_attr_prefill_work_tokens_total', kind='recompute'):>5.0f}"
+            f"  rejected "
+            f"{g('serving_attr_spec_rejected_tokens_total'):>5.0f}"
+            f"  saved "
+            f"{g('serving_prefix_prefill_saved_fraction'):6.1%}")
+        flops = g("serving_model_flops_per_second")
+        mfu = g("serving_mfu_fraction")
+        if flops:
+            mfu_txt = (f"{mfu:6.2%}" if mfu
+                       else "   n/a (chip peak unknown)")
+            lines.append(
+                f" mfu       {mfu_txt}  model "
+                f"{flops / 1e9:10.3f} GFLOP/s")
     lines.append(
         f" latency   ttft p50 {_fmt_s(_snap_quantile(snapshot, 'serving_ttft_seconds', 0.5))}"
         f"  p95 {_fmt_s(_snap_quantile(snapshot, 'serving_ttft_seconds', 0.95))}"

@@ -4,7 +4,9 @@ event a request passes through on the host scheduler — submit, admit
 yields, speculative rounds with acceptance, preempt/resume (the front
 door's eviction pair, with the recompute debt), retire — with
 DUMP-ON-ANOMALY: when a retiring request's TTFT or e2e latency crosses
-its SLO threshold (obs/slo.py), the full journal is captured into a
+its SLO threshold (obs/slo.py), or its preemptions re-computed more
+cached tokens than ``recompute_threshold`` allows (the cost ledger's
+waste signal, obs/attribution.py), the full journal is captured into a
 bounded anomaly buffer and exportable as schema-validated JSON-lines,
 so a slow tail request is *explainable* after the fact, not just a
 histogram bucket (reference: the request-level profile the reference's
@@ -49,6 +51,13 @@ class FlightRecorder:
         ttft_threshold / e2e_threshold: explicit trigger overrides in
             seconds (win over ``slo``); with neither an SLO nor an
             override for a signal, that signal never triggers a dump.
+        recompute_threshold: dump when a retiring request's journaled
+            preemptions re-computed MORE than this many cached tokens
+            (the recompute-waste spike the cost ledger's
+            useful-token-fraction gauge prices; obs/attribution.py).
+            ``None`` (default) never triggers; the count is summed
+            from the journal's own ``preempt`` events, so no new
+            engine plumbing is involved.
         max_live: journal table capacity — requests submitted past it
             ride unjournaled (``dropped_requests`` counts them).
         max_events: per-request journal bound (overflow counted in the
@@ -58,7 +67,8 @@ class FlightRecorder:
     """
 
     def __init__(self, slo=None, ttft_threshold=None, e2e_threshold=None,
-                 max_live=1024, max_events=256, max_anomalies=64):
+                 recompute_threshold=None, max_live=1024,
+                 max_events=256, max_anomalies=64):
         def _trigger(explicit, signal):
             if explicit is not None:
                 return float(explicit)
@@ -69,6 +79,8 @@ class FlightRecorder:
         self.ttft_threshold = _trigger(ttft_threshold, "ttft_seconds")
         self.e2e_threshold = _trigger(e2e_threshold,
                                       "e2e_latency_seconds")
+        self.recompute_threshold = (None if recompute_threshold is None
+                                    else float(recompute_threshold))
         self.max_live = int(max_live)
         self.max_events = int(max_events)
         self.max_anomalies = int(max_anomalies)
@@ -188,6 +200,15 @@ class FlightRecorder:
                 and e2e > self.e2e_threshold):
             signals["e2e_latency_seconds"] = {
                 "value": float(e2e), "threshold": self.e2e_threshold}
+        if self.recompute_threshold is not None:
+            j = self._live.get(str(req.req_id))
+            recomputed = sum(
+                ev.get("cached_tokens", 0) for ev in j["events"]
+                if ev["kind"] == "preempt") if j else 0
+            if recomputed > self.recompute_threshold:
+                signals["recomputed_tokens"] = {
+                    "value": float(recomputed),
+                    "threshold": self.recompute_threshold}
         if signals:
             self._finish(req, signals, reason=reason, t=t,
                          tokens=len(req.tokens))
@@ -217,6 +238,7 @@ class FlightRecorder:
             "dropped_anomalies": self.dropped_anomalies,
             "ttft_threshold": self.ttft_threshold,
             "e2e_threshold": self.e2e_threshold,
+            "recompute_threshold": self.recompute_threshold,
         }
 
     def records(self):

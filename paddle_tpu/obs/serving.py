@@ -39,6 +39,15 @@ Exported serving metrics (all host-boundary):
   free_blocks,utilization}{pool=target|draft}``,
   ``serving_prefix_cache_cached_block_fraction{pool=target|draft}``
   (index-held blocks over blocks in use).
+- cost ledger (obs/attribution.py, owned as ``obs.ledger``):
+  ``serving_attr_tokens_total{phase}`` /
+  ``serving_attr_seconds_total{phase}`` /
+  ``serving_attr_prefill_work_tokens_total{kind}`` /
+  ``serving_attr_spec_rejected_tokens_total`` plus the
+  ``serving_useful_token_fraction`` / ``serving_prefix_prefill_
+  saved_fraction`` / ``serving_model_flops_per_second`` /
+  ``serving_mfu_fraction`` gauges — fed from ``on_quantum`` /
+  ``on_spec_round`` / ``on_cached_prefill`` at the same boundaries.
 - time series (host ring buffers, not prometheus):
   :meth:`timeseries` — ``tokens_per_s`` and ``spec_acceptance_rate``
   points for offline plots, plus the PER-REQUEST sample series the SLO
@@ -53,6 +62,7 @@ import time
 from collections import deque
 from collections.abc import MutableMapping
 
+from .attribution import CostLedger
 from .registry import LATENCY_BUCKETS, MetricsRegistry
 from .trace import TraceRecorder
 
@@ -218,6 +228,11 @@ class ServingObs:
         # kept OUT of reset() so a registry reset restarts the counters
         # from zero without replaying the pool's full history
         self._pc_marks = {}
+        # per-token cost ledger (obs/attribution.py): phase-attributed
+        # tokens/walls + useful-fraction / prefix-savings / MFU gauges,
+        # fed from the SAME boundaries below — no new host callbacks,
+        # and disabled with the rest of the rich hooks (obs="off")
+        self.ledger = CostLedger(r)
         self._window = deque()
         self._cum_tokens = 0
         self._series = {
@@ -431,11 +446,14 @@ class ServingObs:
             (st["cached_blocks"] / in_use) if in_use else 0.0,
             pool=label)
 
-    def on_quantum(self, kind, t0, t1, tokens, rows):
+    def on_quantum(self, kind, t0, t1, tokens, rows, breakdown=None):
         """One dispatch boundary: ``kind`` is ``mixed`` (chunked
         prefill + decode rows through block_mha), ``decode`` (the
         jitted quantum) or ``spec_round``; ``tokens`` is how many
-        tokens the dispatch appended to request streams."""
+        tokens the dispatch appended to request streams. A mixed step
+        passes ``breakdown`` (prefill/decode emission split + novel vs
+        recompute work tokens) for the cost ledger's phase
+        attribution."""
         if not self.enabled:
             return
         self._h_quantum.observe(t1 - t0, kind=kind)
@@ -449,6 +467,9 @@ class ServingObs:
             rate = (self._cum_tokens - c_old) / (t1 - t_old)
             self._g_rate.set(rate)
             self._series["tokens_per_s"].append((t1, rate))
+        self.ledger.on_quantum(kind, t0, t1, tokens,
+                               breakdown=breakdown,
+                               window_rate=self._g_rate.value())
         if self.tracer is not None:
             self.tracer.complete(kind, t0, t1, tid=0,
                                  args={"tokens": int(tokens),
@@ -459,6 +480,15 @@ class ServingObs:
     def on_spec_round(self, now, proposed, accepted):
         if not self.enabled or proposed <= 0:
             return
+        self.ledger.on_spec_round(proposed, accepted)
         rate = accepted / proposed
         self._g_accept.set(rate)
         self._series["spec_acceptance_rate"].append((now, rate))
+
+    def on_cached_prefill(self, req, tokens):
+        """Prompt tokens an admission skipped via a prefix-cache alias
+        — the savings side of the ledger's prefill work split (fires
+        at the existing ``_admit`` boundary)."""
+        if not self.enabled:
+            return
+        self.ledger.on_cached_prefill(tokens)

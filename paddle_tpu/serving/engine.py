@@ -541,6 +541,20 @@ class ServingEngine:
                 self.obs.tracer = TraceRecorder()
         self._now = self.obs.now
         self.stats = self.obs.legacy_stats_view()
+        # cost-ledger MFU constants (obs/attribution.py): target-model
+        # FLOPs per decoded token (2N weight-matmul floor, embedding
+        # gathers excluded) and the chip peak (0.0 off TPU — the MFU
+        # gauge then honestly reads 0 and raw FLOP/s is the number)
+        from ..obs.attribution import decode_flops_per_token
+        from ..profiler.mfu import peak_flops_per_chip
+
+        n_params = sum(int(v.size) for v in self._p_vals)
+        embed = (int(getattr(cfg, "vocab_size", 0))
+                 * int(getattr(cfg, "hidden_size", 0)))
+        self.obs.ledger.configure(
+            flops_per_token=decode_flops_per_token(
+                n_params, n_embedding_params=embed),
+            peak_flops=peak_flops_per_chip())
         # SLO + flight recorder (the operability tier over the obs
         # boundaries): health feeds the front door's shedding policy
         # (serving/frontend.py), and the journal explains a slow tail
@@ -687,6 +701,30 @@ class ServingEngine:
                     self.d_pool.prefix_cache_stats()
         return out
 
+    def attribution(self):
+        """The cost ledger's phase-attribution report
+        (:meth:`~paddle_tpu.obs.attribution.CostLedger.report`) plus
+        the raw counters its conservation invariants are checked
+        against — emitted tokens by phase, wall seconds by phase
+        (prefill / decode / spec_verify / preempt_recompute),
+        novel/recompute/cached prefill work, rejected drafts, and the
+        useful-fraction / prefix-savings / MFU gauges."""
+        rep = self.obs.ledger.report()
+        r = self.obs.registry
+        rep["raw_counters"] = {
+            "serving_tokens_emitted_total":
+                int(r.get("serving_tokens_emitted_total").value()),
+            "serving_prefill_tokens_total":
+                int(self.stats["prefill_tokens"]),
+            "serving_spec_proposed_total":
+                int(self.stats["spec_proposed"]),
+            "serving_spec_accepted_total":
+                int(self.stats["spec_accepted"]),
+            "serving_tokens_recomputed_total":
+                int(r.get("serving_tokens_recomputed_total").value()),
+        }
+        return rep
+
     def decode_step_target(self):
         """(auditable step, example args) for ``analysis.check_budget``
         — the EXACT compiled object the serving hot loop dispatches,
@@ -743,6 +781,7 @@ class ServingEngine:
                 cached = min(req.cached_prefix_tokens,
                              req.prefill_target - 1)
                 req.prefill_pos = cached
+                self.obs.on_cached_prefill(req, cached)
             self._seq_lens[slot] = cached
             self._n_gen[slot] = 0
             self._done[slot] = True  # not decodable until prefill ends
@@ -825,8 +864,16 @@ class ServingEngine:
         rows = pre + dec
         spec = self.spec_draft is not None
         toks, this_time, enc_lens, dec_lens = [], [], [], []
+        # cost-ledger work split: a resumed row's chunk re-computes KV
+        # a preemption dropped (recompute debt); a fresh row's chunk is
+        # novel prefill work (obs/attribution.py)
+        novel_toks = recompute_toks = 0
         for req in pre:
             n = min(chunk, req.prefill_target - req.prefill_pos)
+            if req.preemptions > 0:
+                recompute_toks += n
+            else:
+                novel_toks += n
             toks.append(
                 req.prefill_src[req.prefill_pos:req.prefill_pos + n])
             this_time.append(n)
@@ -891,7 +938,7 @@ class ServingEngine:
             nxt = self._select_host(logits,
                                     [rows[i] for i in need])
         now = self._now()
-        emitted = 0
+        emitted = prefill_emitted = 0
         for i, req in enumerate(rows):
             slot = req.slot
             if i < len(pre):
@@ -924,6 +971,7 @@ class ServingEngine:
                                 req, now, now - req.arrival_time)
                     self._emit(req, tok)
                     emitted += 1
+                    prefill_emitted += 1
                     self._record_host(slot, req, tok)
             else:
                 tok = int(nxt[need.index(i)])
@@ -931,7 +979,13 @@ class ServingEngine:
                 self._emit(req, tok)
                 emitted += 1
                 self._record_host(slot, req, tok)
-        self.obs.on_quantum("mixed", t0, now, emitted, len(rows))
+        self.obs.on_quantum(
+            "mixed", t0, now, emitted, len(rows),
+            breakdown={"prefill_emitted": prefill_emitted,
+                       "decode_emitted": emitted - prefill_emitted,
+                       "novel_tokens": novel_toks,
+                       "recompute_tokens": recompute_toks,
+                       "decode_rows": len(dec)})
         self._retire_finished()
 
     def _emit(self, req, tok):

@@ -397,6 +397,95 @@ def serving_obs_overhead():
     }
 
 
+def attribution_overhead():
+    """ISSUE 10 acceptance row: the cost ledger's price, ISOLATED —
+    two fully-instrumented engines (registry + tracer, identical host
+    boundary work) where the baseline arm's ledger is swapped for a
+    no-op stand-in after construction, so the interleaved windows
+    price exactly the per-quantum attribution bookkeeping (phase
+    pro-rata + counter writes + gauge refresh). Same <3% bar as
+    ``serving_obs_overhead``; the compiled quantum is the same
+    program in both arms (fingerprint-pinned)."""
+    from paddle_tpu.serving import ServingEngine
+
+    cfg, on_tpu = _serving_cfg()
+    model = _build_model(cfg, on_tpu)
+    rng = np.random.RandomState(0)
+    num_slots = 8
+    block_size = 32 if on_tpu else 8
+    t_steps = 16 if on_tpu else 8
+    plen = 16 if on_tpu else 8
+    windows = 5
+    max_ctx = plen + t_steps * (2 * windows + 4) + 8
+    max_ctx = -(-max_ctx // block_size) * block_size
+    kw = dict(num_slots=num_slots, block_size=block_size,
+              prefill_chunk=plen, decode_quantum=t_steps,
+              max_context=max_ctx)
+
+    class _NoLedger:
+        """Same call surface as CostLedger, zero bookkeeping."""
+
+        def configure(self, *a, **k):
+            pass
+
+        def on_quantum(self, *a, **k):
+            pass
+
+        def on_spec_round(self, *a, **k):
+            pass
+
+        def on_cached_prefill(self, *a, **k):
+            pass
+
+    def steady(engine):
+        for _ in range(num_slots):
+            engine.submit(
+                rng.randint(1, cfg.vocab_size, plen).astype(np.int32),
+                max_new_tokens=max_ctx - plen - 4)
+        while (engine.scheduler.prefilling()
+               or not engine.scheduler.decoding()):
+            engine.step()
+        engine._decode_quantum()  # warm/compile
+        return engine
+
+    def window(engine, dispatches):
+        g0 = int(engine._n_gen.sum())
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            engine._decode_quantum()
+        return ((int(engine._n_gen.sum()) - g0)
+                / (time.perf_counter() - t0))
+
+    base = ServingEngine(model, trace=True, **kw)
+    base.obs.ledger = _NoLedger()
+    base = steady(base)
+    inst = steady(ServingEngine(model, trace=True, **kw))
+    pairs = [(window(base, 2), window(inst, 2))
+             for _ in range(windows)]
+    ratios = sorted(i / b for b, i in pairs)
+    ratio = ratios[len(ratios) // 2]
+    overhead_pct = (1.0 - ratio) * 100.0
+    metric = "serving_attribution_overhead_pct"
+    if not on_tpu:
+        metric += "_cpu_smoke"
+    rep = inst.attribution()
+    return {
+        "metric": metric, "value": round(overhead_pct, 2),
+        "unit": "%",
+        "ledger_over_no_ledger": round(ratio, 4),
+        "baseline_tokens_per_sec": round(
+            float(np.median([b for b, _ in pairs])), 1),
+        "ledger_tokens_per_sec": round(
+            float(np.median([i for _, i in pairs])), 1),
+        "decode_quantum": t_steps, "num_slots": num_slots,
+        "useful_token_fraction": round(
+            rep["useful_token_fraction"], 4),
+        "attributed_tokens": int(rep["attributed_tokens_total"]),
+        "obs": _obs_summary(inst),
+        "passes_3pct_bar": bool(overhead_pct < 3.0),
+    }
+
+
 def slo_overhead():
     """ISSUE 6 acceptance row: the operability tier's price — an
     engine with SLO evaluation + the flight recorder on (burn-rate
@@ -1051,6 +1140,7 @@ CONFIGS = {
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,
     "serving_obs_overhead": serving_obs_overhead,
+    "attribution_overhead": attribution_overhead,
     "slo_overhead": slo_overhead,
     "serving_overload": serving_overload,
     "shared_prefix": shared_prefix,

@@ -12,7 +12,8 @@ sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
 serving_engine | speculative_decode | speculative_serving |
-serving_obs_overhead | slo_overhead | serving_overload |
+serving_obs_overhead | attribution_overhead | slo_overhead |
+serving_overload |
 shared_prefix
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
@@ -972,6 +973,16 @@ def serving_obs_overhead():
     return _bench_serving().serving_obs_overhead()
 
 
+def attribution_overhead():
+    """Cost-ledger cost gate (ISSUE 10): decode-quantum throughput
+    with the per-token attribution ledger live vs the same fully-
+    instrumented engine with a no-op ledger stand-in — prices exactly
+    the attribution bookkeeping, same <3% bar and fingerprint-
+    identical quantum as serving_obs_overhead (see
+    scripts/bench_serving.py, artifact BENCH_ATTR_r12.json)."""
+    return _bench_serving().attribution_overhead()
+
+
 def slo_overhead():
     """Operability-tier cost gate (ISSUE 6): decode-quantum throughput
     with per-dispatch SLO burn-rate evaluation + flight-recorder
@@ -1007,6 +1018,7 @@ CONFIGS = {
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,
     "serving_obs_overhead": serving_obs_overhead,
+    "attribution_overhead": attribution_overhead,
     "slo_overhead": slo_overhead,
     "serving_overload": serving_overload,
     "shared_prefix": shared_prefix,

@@ -47,4 +47,9 @@ python -m paddle_tpu.analysis --check --fingerprint
 # serving_prefix_cache_* counters, streams must stay bit-identical to
 # an unshared engine, and the dashboard must render the prefix line.
 python -m paddle_tpu.obs check
-echo "check_graphs: lint + budgets + fingerprints (+obs) all green"
+# Perf sentinel (ISSUE 10): the runtime twin of the graph gate —
+# validate/index the BENCH_*.json trajectory and enforce the declared
+# PerfBudget bands (spec >=1.1x, shed-arm p95 bound >=1.5x, prefix
+# prefill-token ratio >=2x, obs/SLO/attribution overhead <3%, ...).
+scripts/check_perf.sh
+echo "check_graphs: lint + budgets + fingerprints (+obs +perf) all green"

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Perf sentinel: validate + index every BENCH_*.json / MULTICHIP_*.json
+# (schema drift fails), compare against the checked-in BENCH_INDEX.json
+# (staleness fails), and enforce the declared PerfBudget bands (a
+# guarded ratio outside its band fails with a field-level diff).
+# Pure stdlib — runs in ~100ms, no jax import.
+#
+#     scripts/check_perf.sh
+#
+# After an INTENTIONAL bench re-run or band move:
+#     python scripts/validate_bench.py --update   # then review+commit
+# the BENCH_INDEX.json diff like a golden (README "performance
+# sentinel" documents the honest-loosening protocol).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python scripts/validate_bench.py --check
+echo "check_perf: bench trajectory indexed + perf budgets green"

@@ -1,0 +1,98 @@
+"""Validate + index the bench trajectory, then enforce the perf
+budgets (the runtime half of the merge gate; the graph half is
+``python -m paddle_tpu.analysis --check --fingerprint``).
+
+    python scripts/validate_bench.py --check     # the gate (CI)
+    python scripts/validate_bench.py --update    # regenerate BENCH_INDEX.json
+    python scripts/validate_bench.py             # report only
+
+``--check`` regenerates the index in memory from every BENCH_*.json /
+MULTICHIP_*.json in the repo root, fails on (a) schema drift in any
+artifact, (b) a stale/missing checked-in BENCH_INDEX.json, and (c) any
+guarded ratio outside its declared band — each failure as a readable
+field-level diff line. After intentionally re-running a bench or
+moving a band (see README "performance sentinel" for the honest
+protocol), run ``--update`` and review the BENCH_INDEX.json diff like
+a golden.
+
+The perf_budget module is loaded by file path on purpose: the sentinel
+is pure stdlib and must not pay (or depend on) the jax import that
+``import paddle_tpu`` triggers — this script runs in ~100ms anywhere.
+"""
+import importlib.util
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INDEX_PATH = os.path.join(ROOT, "BENCH_INDEX.json")
+
+_spec = importlib.util.spec_from_file_location(
+    "_perf_budget", os.path.join(ROOT, "paddle_tpu", "analysis",
+                                 "perf_budget.py"))
+pb = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(pb)
+
+
+def artifact_paths():
+    paths = [p for p in glob.glob(os.path.join(ROOT, "BENCH_*.json"))
+             if os.path.basename(p) != "BENCH_INDEX.json"]
+    paths += glob.glob(os.path.join(ROOT, "MULTICHIP_*.json"))
+    return sorted(paths, key=os.path.basename)
+
+
+def render_index(index):
+    return json.dumps(index, indent=1, sort_keys=True) + "\n"
+
+
+def fail(lines, header):
+    print(f"validate_bench: FAIL — {header}", file=sys.stderr)
+    for ln in lines:
+        print(f"  - {ln}", file=sys.stderr)
+    return 1
+
+
+def main(argv):
+    update = "--update" in argv
+    check = "--check" in argv
+    budgets = pb.default_perf_budgets()
+    try:
+        index = pb.build_index(artifact_paths(), budgets=budgets)
+    except ValueError as e:
+        return fail([str(e)], "artifact schema drift")
+
+    if update:
+        with open(INDEX_PATH, "w") as f:
+            f.write(render_index(index))
+        print(f"validate_bench: wrote {os.path.basename(INDEX_PATH)} "
+              f"({len(index['artifacts'])} artifacts, "
+              f"{len(index['guarded'])} guarded budgets)")
+    elif check:
+        if not os.path.exists(INDEX_PATH):
+            return fail(
+                ["BENCH_INDEX.json missing — run "
+                 "scripts/validate_bench.py --update and commit it"],
+                "no checked-in index")
+        with open(INDEX_PATH) as f:
+            checked_in = json.load(f)
+        diffs = pb.compare_index(index, checked_in)
+        if diffs:
+            diffs.append("after an INTENTIONAL bench re-run: "
+                         "scripts/validate_bench.py --update, review "
+                         "the BENCH_INDEX.json diff, commit")
+            return fail(diffs, "BENCH_INDEX.json stale")
+
+    try:
+        ok_lines = pb.check_perf(index, budgets)
+    except pb.PerfBudgetViolation as e:
+        return fail(e.violations, "perf budget violation(s)")
+    for ln in ok_lines:
+        print(f"  {ln}")
+    print(f"validate_bench: {len(index['artifacts'])} artifacts "
+          f"indexed, {len(budgets)} budgets green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -16,6 +16,7 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 try:
@@ -24,3 +25,16 @@ except AttributeError:
     # older jax (< 0.5): the XLA_FLAGS above (set before backend init)
     # provides the 8 virtual CPU devices instead
     pass
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh_leak():
+    """A test that dies mid-run with the global mesh installed must not
+    shard-pollute every later test's device_put (seen: the hybrid
+    TP/CP train tests leaking a dp4xmp2 mesh into single-device
+    tests, which then fail batch-divisibility checks)."""
+    yield
+    from paddle_tpu.parallel import mesh as mesh_state
+
+    if mesh_state.has_mesh():
+        mesh_state.set_mesh(None)

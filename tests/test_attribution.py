@@ -1,0 +1,277 @@
+"""Per-token cost attribution (ISSUE 10): the CostLedger's
+conservation invariants across the engine's real boundaries.
+
+Ledger level: mixed-step pro-rata is an EXACT partition of the
+dispatch wall, unknown dispatch kinds still land somewhere, and the
+ledger is stateless over the registry (reset() resets it).
+
+Engine level: a ragged preempt/resume run, a speculative run and a
+prefix-hit run each conserve token-for-token against the legacy
+counters — every emitted token in exactly one phase bucket, prefill
+work decomposing into novel + recompute, rejected drafts equal to
+proposed - accepted, cached tokens equal to what admission skipped —
+and the per-phase seconds sum back to the measured quantum walls.
+
+Operability level: ``engine.attribution()`` carries the report plus
+the raw counters, the dashboard renders the attrib/mfu lines, and a
+forced recompute-waste spike trips the flight recorder's
+dump-on-anomaly into a schema-valid journal served over /anomalies.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.obs import (
+    CostLedger, FlightRecorder, MetricsExporter, MetricsRegistry,
+    decode_flops_per_token, render_dashboard, validate_flight_records,
+)
+from paddle_tpu.obs.attribution import EMIT_PHASES, TIME_PHASES
+from paddle_tpu.serving import ServingEngine
+
+
+def _model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def _assert_conserved(engine):
+    """The design invariants, checked against the legacy counters."""
+    r = engine.obs.registry
+    ledger = engine.obs.ledger
+    emitted = ledger.emitted_tokens()
+    assert sum(emitted.values()) == r.get(
+        "serving_tokens_emitted_total").value()
+    work = ledger.prefill_work()
+    assert work["novel"] + work["recompute"] == engine.stats[
+        "prefill_tokens"]
+    assert ledger.waste_tokens()["spec_rejected"] == (
+        engine.stats["spec_proposed"] - engine.stats["spec_accepted"])
+    hist = r.get("serving_quantum_seconds")
+    wall = sum(hist.sum(kind=k)
+               for k in ("mixed", "decode", "spec_round"))
+    attributed = sum(ledger.phase_seconds().values())
+    assert attributed == pytest.approx(wall, rel=1e-6, abs=1e-9)
+    assert ledger.total_attributed_tokens() == (
+        sum(emitted.values()) + sum(ledger.waste_tokens().values()))
+
+
+# -------------------------------------------------- ledger unit level
+def test_mixed_step_pro_rata_is_exact_partition():
+    """A mixed dispatch's wall splits across novel/recompute/decode by
+    tokens processed and the three shares sum back EXACTLY (pro-rata
+    with no rounding residue); tokens land by emission site."""
+    ledger = CostLedger(MetricsRegistry())
+    ledger.on_quantum(
+        "mixed", 10.0, 10.7, 5,
+        breakdown={"prefill_emitted": 2, "decode_emitted": 3,
+                   "novel_tokens": 8, "recompute_tokens": 4,
+                   "decode_rows": 2})
+    sec = ledger.phase_seconds()
+    assert sum(sec.values()) == pytest.approx(0.7, abs=1e-12)
+    assert sec["prefill"] == pytest.approx(0.7 * 8 / 14)
+    assert sec["preempt_recompute"] == pytest.approx(0.7 * 4 / 14)
+    assert sec["decode"] == pytest.approx(0.7 * 2 / 14)
+    assert ledger.emitted_tokens() == {
+        "prefill": 2, "decode": 3, "spec_verify": 0}
+    assert ledger.prefill_work() == {
+        "novel": 8, "recompute": 4, "cached": 0}
+
+
+def test_ledger_edge_cases_and_reset():
+    """Zero-token mixed steps still attribute their wall (to prefill),
+    unknown kinds land in their own phase rather than vanishing, spec
+    waste never goes negative, and registry.reset() resets the ledger
+    (no shadow state outside the counters)."""
+    reg = MetricsRegistry()
+    ledger = CostLedger(reg)
+    ledger.on_quantum("mixed", 0.0, 0.5, 0, breakdown={})
+    assert ledger.phase_seconds()["prefill"] == pytest.approx(0.5)
+    ledger.on_quantum("drain", 0.0, 0.25, 3)
+    assert reg.get("serving_attr_seconds_total").value(
+        phase="drain") == pytest.approx(0.25)
+    ledger.on_spec_round(proposed=4, accepted=4)   # no rejects
+    ledger.on_spec_round(proposed=4, accepted=1)
+    assert ledger.waste_tokens()["spec_rejected"] == 3
+    reg.reset()
+    assert sum(ledger.emitted_tokens().values()) == 0
+    assert sum(ledger.phase_seconds().values()) == 0.0
+    assert ledger.total_attributed_tokens() == 0
+
+
+def test_decode_flops_per_token_floor():
+    assert decode_flops_per_token(100, 0) == 200.0
+    assert decode_flops_per_token(100, 30) == 140.0
+    assert decode_flops_per_token(10, 99) == 0.0  # clamps, never <0
+
+
+# ---------------------------------------------- engine conservation
+def test_conservation_ragged_preempt_resume():
+    """The acceptance run: ragged requests with a mid-decode eviction;
+    the resumed request's re-prefill must show up as recompute work +
+    preempt_recompute seconds, drop the useful fraction below 1, and
+    every conservation invariant must hold at retirement."""
+    cfg, model = _model()
+    rng = np.random.RandomState(0)
+    engine = ServingEngine(model, num_slots=3, block_size=4,
+                           prefill_chunk=4, decode_quantum=3)
+    reqs = [engine.submit(rng.randint(1, cfg.vocab_size, n)
+                          .astype(np.int32), max_new_tokens=mn)
+            for n, mn in ((5, 6), (9, 4), (3, 8), (12, 5))]
+    while len(reqs[0].tokens) < 2:
+        engine.step()
+    engine.preempt(reqs[0])
+    engine.run()
+    _assert_conserved(engine)
+    ledger = engine.obs.ledger
+    work = ledger.prefill_work()
+    assert work["recompute"] > 0 and work["novel"] > 0
+    assert ledger.phase_seconds()["preempt_recompute"] > 0
+    rep = engine.attribution()
+    assert 0.0 < rep["useful_token_fraction"] < 1.0
+    raw = rep["raw_counters"]
+    assert rep["emitted_total"] == raw["serving_tokens_emitted_total"]
+    assert (rep["prefill_work_tokens"]["novel"]
+            + rep["prefill_work_tokens"]["recompute"]
+            == raw["serving_prefill_tokens_total"])
+
+
+def test_conservation_speculative_run():
+    """The spec arm: verify-emitted tokens land in spec_verify, the
+    rejected-draft counter equals proposed - accepted, and spec_round
+    walls attribute whole."""
+    cfg, model = _model()
+    paddle.seed(7)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        tensor_parallel=False, num_hidden_layers=1))
+    rng = np.random.RandomState(0)
+    engine = ServingEngine(model, num_slots=3, block_size=4,
+                           prefill_chunk=4, decode_quantum=3,
+                           spec_draft=draft, spec_gamma=2)
+    for n, mn in ((5, 6), (9, 4), (3, 8)):
+        engine.submit(rng.randint(1, cfg.vocab_size, n)
+                      .astype(np.int32), max_new_tokens=mn)
+    engine.run()
+    _assert_conserved(engine)
+    ledger = engine.obs.ledger
+    assert engine.stats["spec_proposed"] > 0
+    assert ledger.emitted_tokens()["spec_verify"] > 0
+    assert ledger.phase_seconds()["spec_verify"] > 0
+
+
+def test_conservation_and_savings_prefix_hit():
+    """The prefix arm: the twin request's aliased prompt tokens land
+    in the cached work bucket (exactly its cached_prefix_tokens), the
+    savings gauge reads cached / (cached + computed), and conservation
+    holds with sharing live."""
+    cfg, model = _model()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=2,
+                           prefix_cache=True)
+    engine.submit(prompt.copy(), max_new_tokens=4)
+    engine.step()  # prefill + publish before the twin arrives
+    twin = engine.submit(prompt.copy(), max_new_tokens=4)
+    engine.run()
+    _assert_conserved(engine)
+    work = engine.obs.ledger.prefill_work()
+    assert twin.cached_prefix_tokens == 8  # full prompt aliased
+    # admission caps the skip one position short of the prefill target
+    # (the last prompt position recomputes so the first token can be
+    # emitted), and the ledger counts what was actually SKIPPED
+    assert work["cached"] == min(twin.cached_prefix_tokens,
+                                 len(prompt) - 1) == 7
+    rep = engine.attribution()
+    computed = work["novel"] + work["recompute"]
+    assert rep["prefix_prefill_saved_fraction"] == pytest.approx(
+        work["cached"] / (work["cached"] + computed))
+
+
+# ------------------------------------------------ report + dashboard
+def test_attribution_report_shape_and_mfu_context():
+    """Report schema: phases complete, totals integral, MFU block
+    carries the configured model FLOPs (2N minus embeddings) with the
+    honest 0 MFU off-TPU; the dashboard renders attrib + mfu lines."""
+    cfg, model = _model()
+    rng = np.random.RandomState(0)
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=4, decode_quantum=2)
+    for n in (5, 7):
+        engine.submit(rng.randint(1, cfg.vocab_size, n)
+                      .astype(np.int32), max_new_tokens=4)
+    engine.run()
+    rep = engine.attribution()
+    assert rep["version"] == 1
+    assert set(rep["emitted_tokens"]) == set(EMIT_PHASES)
+    assert set(rep["phase_seconds"]) == set(TIME_PHASES)
+    assert set(rep["prefill_work_tokens"]) == {
+        "novel", "recompute", "cached"}
+    n_params = sum(int(v.size) for v in engine._p_vals)
+    embed = cfg.vocab_size * cfg.hidden_size
+    assert rep["mfu"]["flops_per_token"] == decode_flops_per_token(
+        n_params, embed)
+    assert rep["mfu"]["mfu_fraction"] == 0.0  # CPU: peak unknown
+    frame = render_dashboard(engine.obs.registry.snapshot())
+    assert "attrib" in frame and "useful" in frame
+    assert "mfu" in frame
+    assert json.loads(json.dumps(rep)) == rep  # JSON-able end to end
+
+
+# ------------------------- recompute-waste anomaly -> /anomalies e2e
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_recompute_waste_spike_dumps_anomaly_and_serves(tmp_path):
+    """Satellite (c): a forced preemption under a recompute_threshold
+    of 0 is a recompute-waste spike — the victim's journal must be
+    captured with the recomputed_tokens signal, validate against the
+    flight schema, round-trip through save(), and stream over the
+    exporter's /anomalies endpoint while the attribution gauges are
+    live in /metrics."""
+    cfg, model = _model()
+    rng = np.random.RandomState(0)
+    engine = ServingEngine(
+        model, num_slots=3, block_size=4, prefill_chunk=4,
+        decode_quantum=3, slo=True,
+        flight=FlightRecorder(recompute_threshold=0.0))
+    reqs = [engine.submit(rng.randint(1, cfg.vocab_size, n)
+                          .astype(np.int32), max_new_tokens=mn)
+            for n, mn in ((5, 6), (9, 4), (3, 8))]
+    while len(reqs[0].tokens) < 2:
+        engine.step()
+    engine.preempt(reqs[0])
+    engine.run()
+    recs = engine.flight.records()  # schema-validates
+    spiked = [r for r in recs
+              if "recomputed_tokens" in r["anomaly"]["signals"]]
+    assert len(spiked) == 1
+    sig = spiked[0]["anomaly"]["signals"]["recomputed_tokens"]
+    assert sig["value"] > sig["threshold"] == 0.0
+    assert spiked[0]["req_id"] == str(reqs[0].req_id)
+    # the waste the journal names is the waste the ledger counted
+    assert engine.obs.ledger.prefill_work()["recompute"] >= sig["value"]
+    path = str(tmp_path / "anomalies.jsonl")
+    engine.flight.save(path)
+    exporter = MetricsExporter.for_engine(engine).start()
+    try:
+        status, body = _get(exporter.url("/anomalies"))
+        assert status == 200
+        served = [json.loads(ln) for ln in body.splitlines()]
+        assert validate_flight_records(served) == recs
+        status, prom = _get(exporter.url("/metrics"))
+        assert status == 200
+        assert "serving_useful_token_fraction" in prom
+        assert "serving_attr_tokens_total" in prom
+    finally:
+        exporter.stop()

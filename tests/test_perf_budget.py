@@ -1,0 +1,190 @@
+"""Perf sentinel (ISSUE 10): PerfBudget declarations, three-shape
+artifact normalization, the deterministic BENCH_INDEX, staleness
+detection, and the gate over the repo's REAL checked-in artifacts —
+including the doctored-artifact acceptance case (a spec ratio pushed
+below its floor must fail with a readable field-level diff)."""
+import glob
+import json
+import os
+
+import pytest
+
+from paddle_tpu.analysis.perf_budget import (
+    INDEX_VERSION, PerfBudget, PerfBudgetViolation, build_index,
+    check_perf, compare_index, default_perf_budgets,
+    normalize_artifact,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _repo_artifacts():
+    paths = [p for p in glob.glob(os.path.join(REPO, "BENCH_*.json"))
+             if os.path.basename(p) != "BENCH_INDEX.json"]
+    paths += glob.glob(os.path.join(REPO, "MULTICHIP_*.json"))
+    return paths
+
+
+# ------------------------------------------------------- declarations
+def test_budget_declaration_is_loud():
+    with pytest.raises(TypeError, match="unknown perf-budget field"):
+        PerfBudget("x", "A.json", "m", floor=1.0, celing=2.0)
+    with pytest.raises(TypeError, match="floor and/or ceiling"):
+        PerfBudget("x", "A.json", "m")
+    with pytest.raises(TypeError, match="noise_frac"):
+        PerfBudget("x", "A.json", "m", floor=1.0, noise_frac=1.5)
+
+
+def test_noise_band_widens_both_bounds():
+    b = PerfBudget("x", "A.json", "m", floor=2.0, ceiling=4.0,
+                   noise_frac=0.1)
+    assert b.effective_floor == pytest.approx(1.8)
+    assert b.effective_ceiling == pytest.approx(4.4)
+    assert b.check_row({"metric": "m", "value": 1.85}) == []
+    v = b.check_row({"metric": "m", "value": 1.7})
+    assert len(v) == 1 and "< floor 2" in v[0] and "10%" in v[0]
+    v = b.check_row({"metric": "m", "value": 4.5})
+    assert len(v) == 1 and "> ceiling 4" in v[0]
+    # a bool is not a measurement; neither is a missing field
+    assert "schema drift" in b.check_row({"metric": "m",
+                                          "value": True})[0]
+    assert "schema drift" in b.check_row({"metric": "m"})[0]
+
+
+# ------------------------------------------------------ normalization
+def test_normalize_three_artifact_shapes():
+    flat = normalize_artifact(
+        {"metric": "m", "value": 1.5, "unit": "%", "obs": {"x": 1},
+         "passes": True}, "F.json")
+    assert flat == {"artifact": "F.json", "kind": "bench", "rows": [
+        {"metric": "m", "passes": True, "unit": "%", "value": 1.5}]}
+    rows = normalize_artifact(
+        {"round": 5, "rows": [{"metric": "a", "value": 1},
+                              {"metric": "b", "value": 2,
+                               "detail": [1, 2]}]}, "R.json")
+    assert [r["metric"] for r in rows["rows"]] == ["a", "b"]
+    assert "detail" not in rows["rows"][1]  # nested values dropped
+    drv = normalize_artifact(
+        {"n": 8, "rc": 1, "ok": False, "tail": "boom"}, "D.json")
+    assert drv["kind"] == "driver"
+    assert drv["rows"] == [
+        {"metric": "driver_exit", "rc": 1, "n": 8, "ok": False}]
+
+
+def test_normalize_rejects_drift_naming_the_file():
+    with pytest.raises(ValueError, match="X.json.*JSON object"):
+        normalize_artifact([1, 2], "X.json")
+    with pytest.raises(ValueError, match="X.json.*non-empty list"):
+        normalize_artifact({"rows": []}, "X.json")
+    with pytest.raises(ValueError, match=r"X.json: rows\[1\]"):
+        normalize_artifact(
+            {"rows": [{"metric": "a"}, {"value": 2}]}, "X.json")
+    with pytest.raises(ValueError, match="X.json.*'rc' must be an int"):
+        normalize_artifact({"rc": "one"}, "X.json")
+    with pytest.raises(ValueError, match="unrecognized artifact shape"):
+        normalize_artifact({"something": 1}, "X.json")
+
+
+# ------------------------------------------------- index + staleness
+def test_index_is_deterministic_and_staleness_is_a_diff(tmp_path):
+    a = tmp_path / "BENCH_A.json"
+    b = tmp_path / "BENCH_B.json"
+    a.write_text(json.dumps({"metric": "m", "value": 1.0}))
+    b.write_text(json.dumps({"rc": 0, "n": 2}))
+    budgets = [PerfBudget("m-floor", "BENCH_A.json", "m", floor=0.5)]
+    idx = build_index([str(b), str(a)], budgets)  # order-insensitive
+    assert idx == build_index([str(a), str(b)], budgets)
+    assert idx["version"] == INDEX_VERSION
+    assert [x["artifact"] for x in idx["artifacts"]] == [
+        "BENCH_A.json", "BENCH_B.json"]
+    assert compare_index(idx, idx) == []
+    # the artifact moves but the index does not -> field-level line
+    a.write_text(json.dumps({"metric": "m", "value": 2.0}))
+    fresh = build_index([str(a), str(b)], budgets)
+    diffs = compare_index(fresh, idx)
+    assert len(diffs) == 1
+    assert "rows[0].value indexed as 1.0 but artifact has 2.0" in \
+        diffs[0]
+    # a new artifact that never got indexed is a hole, not a pass
+    c = tmp_path / "BENCH_C.json"
+    c.write_text(json.dumps({"metric": "m2", "value": 3.0}))
+    fresh = build_index([str(a), str(b), str(c)], budgets)
+    assert any("BENCH_C.json: artifact on disk but not indexed" in d
+               for d in compare_index(fresh, idx))
+    # a budget that moved without --update is drift too
+    loose = [PerfBudget("m-floor", "BENCH_A.json", "m", floor=0.1)]
+    assert any("guarded budget declarations drifted" in d
+               for d in compare_index(build_index([str(a)], loose),
+                                      build_index([str(a)], budgets)))
+
+
+def test_gate_over_real_checked_in_artifacts():
+    """The repo's own trajectory must pass its own sentinel, and the
+    checked-in BENCH_INDEX.json must be fresh (what check_perf.sh
+    runs, minus the CLI)."""
+    paths = _repo_artifacts()
+    budgets = default_perf_budgets()
+    index = build_index(paths, budgets)
+    with open(os.path.join(REPO, "BENCH_INDEX.json")) as f:
+        checked_in = json.load(f)
+    assert compare_index(index, checked_in) == []
+    lines = check_perf(index, budgets)
+    assert len(lines) == len(budgets)
+    assert all(ln.startswith("ok  ") for ln in lines)
+
+
+def test_doctored_artifact_fails_with_readable_diff(tmp_path):
+    """Acceptance case: copy the artifacts, push the spec-serving
+    ratio below its floor, rebuild — the gate must fail naming the
+    file, metric, measured value, floor and band in one line."""
+    paths = []
+    for p in _repo_artifacts():
+        dst = tmp_path / os.path.basename(p)
+        with open(p) as f:
+            doc = json.load(f)
+        if dst.name == "BENCH_SPEC_r07.json":
+            for row in doc["rows"]:  # rows-style artifact
+                if row["metric"].startswith(
+                        "speculative_serving_speedup"):
+                    row["value"] = 0.9  # quietly regressed
+        dst.write_text(json.dumps(doc))
+        paths.append(str(dst))
+    budgets = default_perf_budgets()
+    with pytest.raises(PerfBudgetViolation) as ei:
+        check_perf(build_index(paths, budgets), budgets)
+    assert len(ei.value.violations) == 1
+    line = ei.value.violations[0]
+    assert "BENCH_SPEC_r07.json" in line
+    assert "speculative_serving_speedup" in line
+    assert "0.9 < floor 1.1" in line
+    assert "noise band 5% -> 1.045" in line
+    assert "[spec-serving-speedup]" in line
+
+
+def test_missing_artifact_or_metric_is_a_violation(tmp_path):
+    """A deleted artifact (or renamed metric) must fail the budget
+    that guards it, not silently skip."""
+    a = tmp_path / "BENCH_A.json"
+    a.write_text(json.dumps({"metric": "renamed", "value": 9.0}))
+    budgets = [PerfBudget("gone", "BENCH_GONE.json", "m", floor=1.0),
+               PerfBudget("renamed", "BENCH_A.json", "m", floor=1.0)]
+    with pytest.raises(PerfBudgetViolation) as ei:
+        check_perf(build_index([str(a)], budgets), budgets)
+    v = ei.value.violations
+    assert any("BENCH_GONE.json: artifact missing" in x for x in v)
+    assert any("no row with metric 'm'" in x
+               and "'renamed'" in x for x in v)
+
+
+def test_default_budgets_do_not_guard_driver_history():
+    """Driver dumps are history, not claims: MULTICHIP_r02 honestly
+    recorded a libtpu-mismatch failure (rc=1) and the sentinel must
+    index it without demanding it be rewritten."""
+    budgets = default_perf_budgets()
+    assert all(not b.artifact.startswith(("BENCH_r", "MULTICHIP"))
+               for b in budgets)
+    with open(os.path.join(REPO, "MULTICHIP_r02.json")) as f:
+        row = normalize_artifact(json.load(f),
+                                 "MULTICHIP_r02.json")["rows"][0]
+    assert row["rc"] == 1  # indexed as-is
+    check_perf(build_index(_repo_artifacts(), budgets), budgets)

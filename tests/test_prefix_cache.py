@@ -233,6 +233,65 @@ def test_fragmentation_unshared_pool_unchanged():
     assert s["shared_blocks"] == 0 and s["cached_blocks"] == 0
 
 
+def test_stats_snapshot_during_active_cow_stays_consistent():
+    """Regression pin (ISSUE 10, alongside the shared-counted-once
+    pin): a stats snapshot taken INSIDE make_writable's allocate-then-
+    copy window — right after the fresh block leaves the free list,
+    before the table swap and the old block's decref — must not
+    double-count the in-flight block. Before refcount-at-birth,
+    ``blocks_in_use`` already included the fresh block while the
+    refcount map did not, so the accounting the two stats methods
+    publish disagreed mid-COW."""
+    pool = _pool(num_blocks=8, bs=4)
+    toks = np.arange(8, dtype=np.int32)
+    pool.ensure("a", 8)
+    pool.publish_prefix("a", toks)
+    pool.attach_prefix("b", toks)
+
+    snaps = []
+    orig = pool._alloc_block
+
+    def alloc_then_snapshot():
+        blk = orig()
+        # mid-COW: fresh block allocated, device copy / table swap /
+        # old-block decref still pending
+        snaps.append((pool.fragmentation_stats(),
+                      pool.prefix_cache_stats()))
+        return blk
+
+    pool._alloc_block = alloc_then_snapshot
+    copied = pool.make_writable("b", 0, 8)
+    pool._alloc_block = orig
+    assert copied == 2 and len(snaps) == 2
+    for frag, pref in snaps:
+        assert 0.0 <= frag["utilization"] <= 1.0
+        assert frag["blocks_in_use"] <= pool.num_blocks
+        assert pref["cached_blocks"] == 2
+    _audit(pool)
+
+
+def test_stats_raise_on_accounting_drift():
+    """The consistency gate itself: corrupting the ownership
+    structures makes BOTH stats methods raise instead of publishing
+    numbers built on corrupt accounting."""
+    pool = _pool(num_blocks=8, bs=4)
+    pool.ensure("a", 8)
+    blk = pool._tables["a"][0]
+    held = pool._refcounts.pop(blk)  # an allocated-but-untracked block
+    with pytest.raises(RuntimeError, match="accounting drift"):
+        pool.fragmentation_stats()
+    with pytest.raises(RuntimeError, match="accounting drift"):
+        pool.prefix_cache_stats()
+    # same count, wrong identity: a FREE block refcounted in place of
+    # the held one trips the free/held overlap check instead
+    pool._refcounts[pool._free[-1]] = 1
+    with pytest.raises(RuntimeError, match="free and refcounted"):
+        pool.fragmentation_stats()
+    del pool._refcounts[pool._free[-1]]
+    pool._refcounts[blk] = held
+    _audit(pool)
+
+
 # ------------------------------------------------------- ragged churn
 def test_pool_ragged_churn_100_rounds_zero_leaks():
     """100 seeded rounds of ragged admit/attach/publish/COW/trim/free/
