@@ -378,4 +378,14 @@ def default_perf_budgets():
             reason="the jitted decode quantum must beat sequential "
                    "batch-1 generate (observed 1.43-1.64x across "
                    "rounds; floor under the band's low edge)"),
+        PerfBudget(
+            "tp-pool-residency", "BENCH_TP_r13.json",
+            "serving_tp_per_chip_pool_residency_ratio_cpu_smoke",
+            floor=2.0, noise_frac=0.0,
+            reason="per-chip KV pool residency tp1/tp2 is EXACTLY "
+                   "2.0 by construction (kv-head split, integer "
+                   "bytes) — a dropped pool NamedSharding decays it "
+                   "to 1.0, so no noise band; step time on the CPU "
+                   "smoke is informational (two virtual devices on "
+                   "one core)"),
     ]

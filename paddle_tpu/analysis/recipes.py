@@ -362,6 +362,61 @@ def _build_serving_prefix_step():
     return recipe
 
 
+def _build_serving_tp_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=True, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    # the TP-SHARDED serving quantum (tp=2 over the "mp" axis): params
+    # split along heads/ffn through the SAME mp layers the training
+    # recipes pin, KV pool leaves split along the kv-head axis (so
+    # prefix aliasing/COW stay pure block-table ops under TP), and the
+    # quantum still ONE jitted dispatch — its collectives live IN the
+    # graph, and the census caps below pin their count and byte
+    # volume. The tp=1 recipes' goldens must stay byte-identical: the
+    # mesh enters only through this builder's engine.
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=4,
+                           trace=True, slo=True, flight=True, tp=2)
+    rng = np.random.RandomState(0)
+    engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
+                  max_new_tokens=8)
+    engine.step()  # admit + prefill so the audited state is live
+    target, args = engine.decode_step_target()
+    budget = Budget(
+        name="TP2 serving decode quantum (bf16, 2-chip mesh)",
+        max_remat=0,
+        max_f32_matmuls=0,        # bf16 pool/params stay bf16
+        max_host_callbacks=0,     # scheduler stays at host boundaries
+        require_donated=True,     # the 2L KV pool leaves, still donated
+        # the quantum's collective shape: one lm-head all-gather plus
+        # one all-reduce per row-parallel matmul (2/layer) and the
+        # embedding constraint — audited 6 ops / 35 328 B; the byte cap
+        # leaves ~30% headroom, a per-layer re-gather of params or a
+        # full-logits broadcast blows through it
+        max_total_collectives=8,
+        max_collective_bytes=46_000,
+        # the donatable pool leaves must CARRY the mp axis (kv-head
+        # split) — a refactor that drops the NamedSharding silently
+        # replicates the pool per chip and doubles its HBM cost
+        min_sharded_params=4,
+        max_replicated_param_bytes=0,
+        # audited 138 KB compiled temp (per-chip halves of the tp1
+        # quantum's buffers) / 891 KB jaxpr trace peak — the liveness
+        # walk is LOGICAL (pre-partitioning), so the peak cap matches
+        # serving_decode_step's; same ~30% headroom on both
+        max_temp_bytes=180_000,
+        max_peak_live_bytes=1_300_000,
+    )
+    recipe = Recipe("serving_tp_step", target, args, budget)
+    recipe.engine = engine  # obs CLI asserts the instrumented engine
+    return recipe
+
+
 RECIPES = {
     "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
     "llama_decode_greedy": _build_llama_decode_greedy,
@@ -369,6 +424,7 @@ RECIPES = {
     "speculative_verify_step": _build_speculative_verify_step,
     "serving_frontdoor_step": _build_serving_frontdoor_step,
     "serving_prefix_step": _build_serving_prefix_step,
+    "serving_tp_step": _build_serving_tp_step,
 }
 
 

@@ -36,40 +36,48 @@ _DEVICES_RE = re.compile(r"devices=\[([\d,]+)\]")
 
 
 def _classify(attr):
-    """True when the sharding attr describes a fully-replicated (or
-    single-device-owned) layout; tile assignments that split at least
-    one data dimension count as sharded."""
+    """``(replicated, unknown)`` for one sharding attr. ``replicated``
+    is True when the attr describes a fully-replicated (or single-
+    device-owned) layout; tile assignments that split at least one data
+    dimension count as sharded. ``unknown`` flags syntax the parser
+    didn't recognize: it is still CLASSIFIED replicated — a parser gap
+    can only make the audit stricter, never hide a replicated leaf —
+    but counted separately so a report (and its fingerprint) can tell
+    "parser gap" apart from "actually replicated"."""
     if attr is None or attr == "" or "replicated}" in attr.replace(
             "last_tile_dim_replicate}", ""):
-        return True
+        return True, False
     if "maximal" in attr:
-        return True
+        return True, False
     m = _DEVICES_RE.search(attr)
     if m is None:
-        # unknown syntax: treat as replicated so a parser gap can only
-        # make the audit STRICTER, never hide a replicated leaf
-        return True
+        # unknown syntax: strict-but-counted (see docstring)
+        return True, True
     dims = [int(d) for d in m.group(1).split(",")]
     if "last_tile_dim_replicate" in attr and len(dims) > 1:
         dims = dims[:-1]  # trailing dim is the replication group
-    return all(d == 1 for d in dims)
+    return all(d == 1 for d in dims), False
 
 
 class ArgSharding:
     """One entry argument's layout: byte size, the raw sharding attr
-    (``""`` when the argument carries none), and the replicated
-    verdict."""
+    (``""`` when the argument carries none), the replicated verdict,
+    and whether that verdict came from UNRECOGNIZED attr syntax (the
+    strict fallback) rather than a parsed layout."""
 
-    __slots__ = ("index", "nbytes", "spec", "replicated")
+    __slots__ = ("index", "nbytes", "spec", "replicated", "unknown")
 
-    def __init__(self, index, nbytes, spec, replicated):
+    def __init__(self, index, nbytes, spec, replicated, unknown=False):
         self.index = index
         self.nbytes = nbytes
         self.spec = spec
         self.replicated = replicated
+        self.unknown = unknown
 
     def __repr__(self):
         kind = "replicated" if self.replicated else "sharded"
+        if self.unknown:
+            kind += " (unknown syntax)"
         return (f"ArgSharding(arg{self.index}, {self.nbytes}B, {kind}"
                 + (f", {self.spec!r}" if self.spec else "") + ")")
 
@@ -112,15 +120,29 @@ class ShardingReport:
         reps = self.replicated_params()
         return reps[0].nbytes if reps else 0
 
+    @property
+    def unknown_count(self):
+        """Args whose sharding attr the parser did not recognize (they
+        are classified replicated — the strict fallback — but a nonzero
+        count means 'parser gap', not 'actually replicated')."""
+        return sum(1 for a in self.args if a.unknown)
+
     def summary_dict(self):
-        """Stable scalar summary (fingerprint + CLI material)."""
-        return {
+        """Stable scalar summary (fingerprint + CLI material). The
+        ``unknown_shardings`` key appears ONLY when nonzero: fingerprint
+        comparison flags any new key as drift, so an always-present key
+        would invalidate every existing golden for the common (fully
+        parsed) case."""
+        out = {
             "n_args": len(self.args),
             "n_sharded": self.sharded_count,
             "n_sharded_params": self.sharded_param_count,
             "max_replicated_param_bytes":
                 self.max_replicated_param_bytes,
         }
+        if self.unknown_count:
+            out["unknown_shardings"] = self.unknown_count
+        return out
 
 
 def audit_sharding(stablehlo_text, n_donatable=None):
@@ -135,7 +157,9 @@ def audit_sharding(stablehlo_text, n_donatable=None):
         attrs = _scan_attrs(stablehlo_text, m.end())
         sm = _SHARDING_ATTR_RE.search(attrs)
         spec = sm.group(1) if sm else ""
+        replicated, unknown = _classify(spec)
         seen[idx] = ArgSharding(
-            idx, _tensor_bytes(m.group(2)), spec, _classify(spec))
+            idx, _tensor_bytes(m.group(2)), spec, replicated,
+            unknown=unknown)
     args = [seen[i] for i in sorted(seen)]
     return ShardingReport(args, n_donatable=n_donatable)

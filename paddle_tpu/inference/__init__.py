@@ -179,8 +179,19 @@ def create_serving_engine(model, **kwargs):
     residency scale with UNIQUE tokens — the shared-system-prompt
     TTFT win), with streams bit-identical to the unshared engine.
     Per-request knobs ride ``engine.submit`` — priority, temperature,
-    stop_token_ids, stop_sequences, max_new_tokens, seed. See
-    :mod:`paddle_tpu.serving`."""
+    stop_token_ids, stop_sequences, max_new_tokens, seed.
+
+    TENSOR-PARALLEL SERVING: pass ``tp=2`` (or an explicit ``mesh=``
+    with an ``"mp"`` axis) to shard the whole quantum family over the
+    device mesh — params split along heads/ffn, paged KV pools split
+    along the kv-head axis, the quantum stays ONE jitted dispatch with
+    in-graph collectives, and streams stay bit-exact vs the tp=1
+    engine. The model must be built ``tensor_parallel=True`` and its
+    head counts must divide ``tp``; requesting ``tp>1`` with fewer
+    visible devices raises with the CPU virtual-device setup
+    (``XLA_FLAGS='--xla_force_host_platform_device_count=N'``). See
+    :mod:`paddle_tpu.serving` and the README "TP-sharded serving"
+    section."""
     from ..serving import ServingEngine
 
     return ServingEngine(model, **kwargs)
@@ -205,7 +216,10 @@ def serve(model, policy=None, slo=True, flight=True, **kwargs):
     OFF this release) enables content-addressed prefix caching —
     shared system prompts alias cached KV blocks instead of
     re-prefilling, ``TokenStream.cached_prefix_tokens`` reports the
-    per-request win. Remaining keyword args forward to the engine
+    per-request win. ``tp=2`` / ``mesh=`` shard the engine's quantum
+    over the device mesh (tensor-parallel model required; streams stay
+    bit-exact — :func:`create_serving_engine` documents the setup).
+    Remaining keyword args forward to the engine
     (:func:`create_serving_engine` documents them).
 
     ::

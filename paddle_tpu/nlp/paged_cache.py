@@ -27,6 +27,7 @@ pressure and only at refcount one (no live holder).
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 __all__ = ["PagedKVCachePool"]
@@ -75,10 +76,20 @@ class PagedKVCachePool:
         block_size: tokens per block (lane-friendly: 16/32/64...).
         num_kv_heads, head_dim, num_layers: cache geometry.
         dtype: cache dtype (bf16 for serving).
+        mesh: optional ``jax.sharding.Mesh`` with an ``"mp"`` axis. The
+            pool arrays are placed head-sharded across it
+            (``P(None, None, "mp", None)`` — each chip holds every
+            block for ITS KV heads), so block ids, tables, refcounts,
+            prefix chains, and COW stay plain host bookkeeping: sharing
+            splits WITHIN a block along the head dim, never across
+            blocks, so one logical block id aliases the same rows on
+            every chip. Falls back to replication when ``num_kv_heads``
+            does not divide by the mesh's ``mp`` size.
     """
 
     def __init__(self, num_blocks, block_size, num_kv_heads, head_dim,
-                 num_layers=1, dtype=jnp.bfloat16, prefix_cache=False):
+                 num_layers=1, dtype=jnp.bfloat16, prefix_cache=False,
+                 mesh=None):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_kv_heads = int(num_kv_heads)
@@ -86,8 +97,22 @@ class PagedKVCachePool:
         self.num_layers = int(num_layers)
         shape = (self.num_blocks, self.block_size, self.num_kv_heads,
                  self.head_dim)
+        self.mesh = mesh
+        self._pool_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            mp = int(mesh.shape.get("mp", 1))
+            spec = (PartitionSpec(None, None, "mp", None)
+                    if mp > 1 and self.num_kv_heads % mp == 0
+                    else PartitionSpec())
+            self._pool_sharding = NamedSharding(mesh, spec)
         self.k_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
         self.v_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        if self._pool_sharding is not None:
+            self.k_pools = [jax.device_put(p, self._pool_sharding)
+                            for p in self.k_pools]
+            self.v_pools = [jax.device_put(p, self._pool_sharding)
+                            for p in self.v_pools]
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id -> list[int] block ids
         self._lens: dict = {}     # seq_id -> int tokens
@@ -315,10 +340,10 @@ class PagedKVCachePool:
                 continue
             fresh = self._alloc_block()  # born refcounted
             for i in range(self.num_layers):
-                self.k_pools[i] = self.k_pools[i].at[fresh].set(
-                    self.k_pools[i][blk])
-                self.v_pools[i] = self.v_pools[i].at[fresh].set(
-                    self.v_pools[i][blk])
+                self.k_pools[i] = self._pin(self.k_pools[i].at[fresh].set(
+                    self.k_pools[i][blk]))
+                self.v_pools[i] = self._pin(self.v_pools[i].at[fresh].set(
+                    self.v_pools[i][blk]))
             table[j] = fresh
             self._release([blk])
             copies += 1
@@ -536,12 +561,40 @@ class PagedKVCachePool:
             "cached_blocks": len(self._cached_blocks),
         }
 
+    def _pin(self, arr):
+        """Keep an eagerly-updated pool array on its mesh layout. The
+        COW copy runs as eager ops whose output placement follows XLA's
+        propagation; re-asserting the pool sharding here is a no-op
+        when propagation already kept it and a reshard otherwise, so
+        the donated quantum inputs never silently change layout."""
+        if self._pool_sharding is None:
+            return arr
+        return jax.device_put(arr, self._pool_sharding)
+
+    @property
+    def tp_shards(self):
+        """How many ways the KV-head dim is split across the mesh (1
+        when unsharded/replicated)."""
+        if self._pool_sharding is None or self.mesh is None:
+            return 1
+        if self._pool_sharding.spec == ():
+            return 1
+        return int(self.mesh.shape.get("mp", 1))
+
     def bytes_in_use(self):
         """Live cache bytes — the paged-cache memory claim: scales with
         allocated blocks, not batch × max_seq."""
         per_block = (self.block_size * self.num_kv_heads * self.head_dim
                      * self.k_pools[0].dtype.itemsize)
         return 2 * self.num_layers * self.blocks_in_use * per_block
+
+    def per_chip_bytes_in_use(self):
+        """Live cache bytes RESIDENT PER CHIP: under a head-sharded
+        mesh layout each chip holds ``num_kv_heads / tp`` heads of
+        every allocated block, so per-chip residency is the global
+        claim divided by the shard count (exactly — the head dim must
+        divide for the pool to shard at all)."""
+        return self.bytes_in_use() // self.tp_shards
 
     # -- device views ------------------------------------------------------
     def block_table_array(self, seq_ids, pad_to=None):

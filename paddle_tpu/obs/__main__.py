@@ -241,7 +241,31 @@ def _cmd_watch(args):
 
 
 _CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step",
-                  "serving_frontdoor_step", "serving_prefix_step")
+                  "serving_frontdoor_step", "serving_prefix_step",
+                  "serving_tp_step")
+
+_REEXEC_GUARD = "_PADDLE_TPU_OBS_REEXEC"
+
+
+def _ensure_check_devices(argv, need=8):
+    """``check`` now audits the tp=2 serving recipe, which needs a
+    multi-device mesh; on a 1-device host platform, re-exec with the
+    virtual-device flag set before jax initializes (the same conftest
+    trick analysis/__main__.py uses). Inert when enough devices are
+    already visible."""
+    import os
+
+    import jax
+
+    if jax.device_count() >= need or os.environ.get(_REEXEC_GUARD):
+        return
+    flag = f"--xla_force_host_platform_device_count={need}"
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+    env[_REEXEC_GUARD] = "1"
+    cmd = [sys.executable, "-m", "paddle_tpu.obs"] + list(
+        argv if argv is not None else sys.argv[1:])
+    os.execve(sys.executable, cmd, env)
 
 
 def _check_slo_smoke():
@@ -612,6 +636,8 @@ def main(argv=None):
     p.set_defaults(fn=_cmd_check)
 
     args = ap.parse_args(argv)
+    if args.cmd == "check":
+        _ensure_check_devices(argv)
     return args.fn(args)
 
 

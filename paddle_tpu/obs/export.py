@@ -335,5 +335,11 @@ def render_dashboard(snapshot, report=None, width=62):
             lines.append(
                 f" prefix[{pool:<4}] hits {hits:>6.0f}  misses "
                 f"{misses:>6.0f}  cow {cow:>4.0f}  cached {frac:6.1%}")
+    coll_bytes = g("serving_collective_bytes_total")
+    if coll_bytes:
+        lines.append(
+            f" tp        collectives/quantum "
+            f"{g('serving_collective_count_total'):>4.0f} ops, "
+            f"{coll_bytes / 1024.0:>9.1f} KiB")
     lines.append(bar)
     return "\n".join(lines) + "\n"

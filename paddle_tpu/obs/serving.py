@@ -38,7 +38,12 @@ Exported serving metrics (all host-boundary):
   ``serving_slots_occupied``, ``serving_pool_{blocks_in_use,
   free_blocks,utilization}{pool=target|draft}``,
   ``serving_prefix_cache_cached_block_fraction{pool=target|draft}``
-  (index-held blocks over blocks in use).
+  (index-held blocks over blocks in use), and the TP census pair
+  ``serving_collective_{bytes,count}_total`` (unlabeled totals plus a
+  ``{kind=all-reduce|...}`` split) — bytes/ops ONE compiled quantum
+  dispatch moves over mesh collectives, read off the executable's HLO
+  at engine build (:meth:`ServingObs.set_quantum_collectives`), never
+  from runtime callbacks.
 - cost ledger (obs/attribution.py, owned as ``obs.ledger``):
   ``serving_attr_tokens_total{phase}`` /
   ``serving_attr_seconds_total{phase}`` /
@@ -223,6 +228,19 @@ class ServingObs:
         self._g_pc_frac = r.gauge(
             "serving_prefix_cache_cached_block_fraction",
             "index-held blocks / blocks in use")
+        # per-quantum collective census (TP serving): bytes/op counts
+        # the ONE jitted quantum moves over mesh collectives, read off
+        # the compiled HLO at engine build (analysis/collectives.py).
+        # A static property of the executable — set once, never from
+        # runtime callbacks, so the hot path stays untouched
+        self._g_coll_bytes = r.gauge(
+            "serving_collective_bytes_total",
+            "bytes one quantum dispatch moves over mesh collectives "
+            "(compiled-HLO census at engine build; 0 when tp=1)")
+        self._g_coll_count = r.gauge(
+            "serving_collective_count_total",
+            "mesh collective ops in one quantum dispatch, by kind")
+        self.quantum_collectives = {}
         # (pool identity, counter attr) -> last value synced; keyed by
         # id() so engines sharing one registry don't cross-credit, and
         # kept OUT of reset() so a registry reset restarts the counters
@@ -445,6 +463,24 @@ class ServingObs:
         self._g_pc_frac.set(
             (st["cached_blocks"] / in_use) if in_use else 0.0,
             pool=label)
+
+    def set_quantum_collectives(self, info):
+        """Publish the engine-build collective census: ``info`` is the
+        engine's ``quantum_collectives`` dict (``tp``, ``count_total``,
+        ``bytes_total``, per-kind ``by_kind``). Called once at engine
+        construction — the census is a property of the compiled
+        executable, so the gauges never move after build. The totals
+        are published unlabeled and the per-kind split under
+        ``{kind=all-reduce|all-gather|...}`` on the same two gauges."""
+        self.quantum_collectives = dict(info or {})
+        if not self.enabled:
+            return
+        info = self.quantum_collectives
+        self._g_coll_bytes.set(float(info.get("bytes_total", 0)))
+        self._g_coll_count.set(float(info.get("count_total", 0)))
+        for kind, d in (info.get("by_kind") or {}).items():
+            self._g_coll_bytes.set(float(d["bytes"]), kind=kind)
+            self._g_coll_count.set(float(d["count"]), kind=kind)
 
     def on_quantum(self, kind, t0, t1, tokens, rows, breakdown=None):
         """One dispatch boundary: ``kind`` is ``mixed`` (chunked

@@ -68,9 +68,28 @@ scheduler boundaries: the compiled quantum's ``max_host_callbacks=0``
 budget and golden fingerprint are unchanged (the
 ``serving_frontdoor_step`` recipe pins the per-request-sampling
 variant with its own golden).
+
+TENSOR-PARALLEL SERVING (``mesh=`` / ``tp=``): the whole quantum
+family — default greedy/sampling, the per-request-sampling front-door
+variant, the speculative draft+verify round, and the mixed chunked-
+prefill batches — runs head/ffn-sharded over a 1-axis ``("mp",)``
+mesh. Params are re-placed at engine build with the same tp2 layouts
+the training recipes pin (column: out-dim, row: in-dim, vocab-parallel
+embedding), the paged pools go head-sharded (each chip holds every
+block for ITS KV heads, so refcounted prefix sharing and COW stay pure
+host bookkeeping), and each quantum remains ONE jitted dispatch whose
+collectives GSPMD inserts in-graph — pools still donated, zero host
+callbacks. The static collective profile (count/bytes by kind, read
+from the compiled module at build) feeds the obs gauges and
+``engine_stats()``; the ``serving_tp_step`` recipe pins the sharded
+graph with ``min_sharded_params`` + a collective-byte cap and its own
+golden. With no mesh (the default) every graph is byte-identical to
+the single-chip engine — the tp parity tests exploit exactly that:
+same seed, no mesh at model build, identical weights either way.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 
 import numpy as np
@@ -85,9 +104,102 @@ from ..nlp.paged_cache import PagedKVCachePool
 from ..obs.flight import FlightRecorder
 from ..obs.serving import ServingObs
 from ..obs.slo import SLOSet
+from ..parallel import mesh as mesh_state
+from ..parallel.mesh import MeshScope
 from .scheduler import Request, Scheduler, SchedulerConfig
 
 __all__ = ["ServingEngine"]
+
+
+def _resolve_tp_mesh(mesh, tp):
+    """Normalize the engine's ``mesh=``/``tp=`` kwargs into
+    ``(Mesh | None, tp_size)``. ``tp=1`` (or both None) is the
+    single-chip engine — no mesh, byte-identical graphs. A bare ``tp=N``
+    builds a 1-axis ``("mp",)`` mesh over the first N visible devices;
+    an explicit mesh must carry an ``"mp"`` axis (and agree with ``tp``
+    when both are given)."""
+    if mesh is None and (tp is None or int(tp) <= 1):
+        return None, 1
+    from jax.sharding import Mesh
+
+    if mesh is not None:
+        if "mp" not in mesh.shape:
+            raise ValueError(
+                f"serving mesh has axes {tuple(mesh.shape)} but no 'mp' "
+                f"axis: the quantum family shards params and KV pools "
+                f"along 'mp' — build the mesh with an 'mp' axis (e.g. "
+                f"Mesh(np.array(jax.devices()[:2]), ('mp',)))")
+        size = int(mesh.shape["mp"])
+        if tp is not None and int(tp) != size:
+            raise ValueError(
+                f"tp={tp} disagrees with the mesh's 'mp' axis size "
+                f"{size}: pass only one, or make them match")
+        return (mesh, size) if size > 1 else (None, 1)
+    tp = int(tp)
+    devs = jax.devices()
+    if tp > len(devs):
+        raise ValueError(
+            f"tp={tp} needs {tp} visible devices but jax sees only "
+            f"{len(devs)} ({devs[0].platform}). On CPU, expose virtual "
+            f"devices BEFORE jax initializes — either "
+            f"XLA_FLAGS='--xla_force_host_platform_device_count={tp}' "
+            f"in the environment or "
+            f"jax.config.update('jax_num_cpu_devices', {tp}) at startup "
+            f"— then rebuild the engine")
+    return Mesh(np.array(devs[:tp]), ("mp",)), tp
+
+
+def _check_tp_divisible(cfg, tp, role):
+    """The head-sharded layout needs both head counts to divide by tp:
+    attention is computed per head, so a non-divisible count would force
+    replicated attention and the pool could not shard at all."""
+    if cfg.num_attention_heads % tp or cfg.num_key_value_heads % tp:
+        raise ValueError(
+            f"{role} model has num_attention_heads="
+            f"{cfg.num_attention_heads}, num_key_value_heads="
+            f"{cfg.num_key_value_heads}; both must divide by tp={tp} "
+            f"for the head-sharded quantum layout")
+
+
+def _tp_shard_params(model):
+    """Re-place a tensor-parallel model's params onto the INSTALLED
+    mesh (call under ``MeshScope``): mp-layer weights split along their
+    parallel dim — the same tp2 layout the training recipes pin — and
+    every other param committed replicated, so all quantum inputs are
+    mesh-addressed. The model must have been BUILT with
+    ``tensor_parallel=True`` but WITHOUT a mesh: mp layers then
+    initialize exactly like their serial twins (same seed -> identical
+    weights), which is what makes tp-vs-single-chip streams comparable
+    bit-for-bit. Returns the number of mp-layer weights sharded (0
+    means the model has no tensor-parallel structure)."""
+    from ..distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+    placed = set()
+
+    def put(param, *spec):
+        param._value = mesh_state.shard_value(param._value, *spec)
+        placed.add(id(param))
+
+    n_sharded = 0
+    for _, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, ColumnParallelLinear):
+            put(layer.weight, None, "mp")
+            n_sharded += 1
+            if layer.bias is not None:
+                put(layer.bias, "mp")
+        elif isinstance(layer, RowParallelLinear):
+            put(layer.weight, "mp", None)
+            n_sharded += 1
+            if layer.bias is not None:
+                put(layer.bias)  # replicated: added after the all-reduce
+        elif isinstance(layer, VocabParallelEmbedding):
+            put(layer.weight, "mp", None)
+            n_sharded += 1
+    for _, p in model.named_parameters():
+        if id(p) not in placed:
+            p._value = mesh_state.replicate_value(p._value)
+    return n_sharded
 
 
 def _rope_rows(x, cos, sin):
@@ -169,6 +281,20 @@ def _paged_attn(q, kp, vp, tables, lens):
     return _xla_paged_decode_attn(q, kp, vp, tables, lens)
 
 
+def _pin_kv(arr):
+    """Constrain one per-layer pool array to the head-sharded mesh
+    layout (``P(None, None, 'mp', None)``) so GSPMD keeps the donated
+    pool outputs on exactly the layout they arrived in — the in-place
+    block write must never force a gather/reshard of the whole pool.
+    Identity when no mesh is installed, ``mp == 1``, or the KV-head dim
+    doesn't divide: the single-chip quantum graphs (and their golden
+    fingerprints) are untouched byte-for-byte."""
+    mp = mesh_state.mesh_axis_size("mp")
+    if mp > 1 and arr.shape[2] % mp == 0:
+        return mesh_state.constraint(arr, None, None, "mp", None)
+    return arr
+
+
 def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
                       kc, vc, live):
     """One token for every slot over a paged pool (the quantum's
@@ -208,10 +334,10 @@ def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
         v = attn.v_proj(x).reshape([s, 1, hk, d])
         qv = _rope_rows(q._value[:, 0], cos, sin)    # (S, H, D)
         kv = _rope_rows(k._value[:, 0], cos, sin)
-        kci = kc[i].at[write_blk, write_off].set(
-            kv.astype(kc[i].dtype))
-        vci = vc[i].at[write_blk, write_off].set(
-            v._value[:, 0].astype(vc[i].dtype))
+        kci = _pin_kv(kc[i].at[write_blk, write_off].set(
+            kv.astype(kc[i].dtype)))
+        vci = _pin_kv(vc[i].at[write_blk, write_off].set(
+            v._value[:, 0].astype(vc[i].dtype)))
         new_kc.append(kci)
         new_vc.append(vci)
         att = _paged_attn(qv, kci, vci, tables, lens)
@@ -268,10 +394,10 @@ def paged_chunk_math(model, scratch_block, ids_t, seq_lens, tables,
         v = attn.v_proj(x).reshape([s, c, hk, d])
         qv = _rope_rows(q._value, cos, sin)          # (S, C, H, D)
         kv = _rope_rows(k._value, cos, sin)
-        kci = kc[i].at[write_blk, write_off].set(
-            kv.astype(kc[i].dtype))
-        vci = vc[i].at[write_blk, write_off].set(
-            v._value.astype(vc[i].dtype))
+        kci = _pin_kv(kc[i].at[write_blk, write_off].set(
+            kv.astype(kc[i].dtype)))
+        vci = _pin_kv(vc[i].at[write_blk, write_off].set(
+            v._value.astype(vc[i].dtype)))
         new_kc.append(kci)
         new_vc.append(vci)
         att = _xla_paged_chunk_attn(qv, kci, vci, tables, base_lens)
@@ -289,18 +415,29 @@ class _AuditedStep:
     declares how many LEADING flat args the quantum donates (the KV
     pool leaves — 2L for the plain quantum, 2L_target + 2L_draft for
     the speculative round) so ``require_donated`` audits the right
-    set."""
+    set. A TP engine also carries its mesh: the audit re-traces the
+    quantum OUTSIDE the engine's dispatch path, so trace and lowering
+    here must run under the same ``MeshScope`` the engine uses (mp
+    layers degrade to serial math when no mesh is installed)."""
 
-    def __init__(self, jitted, n_donatable, name="serving_decode_quantum"):
+    def __init__(self, jitted, n_donatable, name="serving_decode_quantum",
+                 mesh=None):
         self._jitted = jitted
         self.n_donatable = int(n_donatable)
         self.__name__ = name
+        self._mesh = mesh
+
+    def _scope(self):
+        return (MeshScope(self._mesh) if self._mesh is not None
+                else contextlib.nullcontext())
 
     def __call__(self, *args):
-        return self._jitted(*args)
+        with self._scope():
+            return self._jitted(*args)
 
     def lower(self, *args):
-        return self._jitted.lower(*args)
+        with self._scope():
+            return self._jitted.lower(*args)
 
 
 class ServingEngine:
@@ -381,6 +518,30 @@ class ServingEngine:
             dumps its full journal to ``engine.flight.anomalies``.
             Like every obs hook, the compiled quantum is untouched
             (fingerprint-gated).
+        mesh / tp: TENSOR-PARALLEL SERVING. ``tp=N`` (N > 1) builds a
+            1-axis ``("mp",)`` mesh over the first N visible devices;
+            ``mesh=`` passes an explicit ``jax.sharding.Mesh`` with an
+            ``"mp"`` axis instead (both together must agree). The model
+            (and draft) must be BUILT with ``tensor_parallel=True`` but
+            WITHOUT a global mesh — mp layers then initialize exactly
+            like their serial twins, so a tp engine and a single-chip
+            engine seeded identically hold identical weights and their
+            streams compare bit-for-bit (the tier-1 parity oracle). At
+            engine build the params are re-placed head/ffn-sharded
+            (Column/Row-parallel + vocab-parallel layouts, the same tp2
+            placement the training recipes pin), the paged KV pools go
+            head-sharded (``P(None, None, 'mp', None)`` — block ids and
+            refcounted prefix sharing/COW stay plain host bookkeeping),
+            and every quantum variant remains ONE jitted dispatch with
+            in-graph collectives, pools still donated. The quantum's
+            static collective profile (count + bytes by kind, from the
+            compiled module at build — never runtime callbacks) lands
+            in ``engine_stats()['quantum_collectives']`` and the obs
+            registry. Default ``tp=None`` (single chip): no mesh, and
+            every compiled graph — and golden fingerprint — is
+            byte-identical to previous releases. On CPU expose virtual
+            devices BEFORE jax initializes (e.g.
+            ``XLA_FLAGS='--xla_force_host_platform_device_count=8'``).
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
@@ -389,7 +550,7 @@ class ServingEngine:
                  temperature=1.0, eos_token_id=None, spec_draft=None,
                  spec_gamma=4, prefix_cache=False,
                  per_request_sampling=False, obs=None,
-                 trace=False, slo=None, flight=None):
+                 trace=False, slo=None, flight=None, mesh=None, tp=None):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -411,6 +572,11 @@ class ServingEngine:
                 "per_request_sampling does not compose with spec_draft "
                 "yet: the speculative round's acceptance math takes the "
                 "engine-wide temperature")
+        self.mesh, self.tp = _resolve_tp_mesh(mesh, tp)
+        if self.tp > 1:
+            _check_tp_divisible(cfg, self.tp, "target")
+            if spec_draft is not None:
+                _check_tp_divisible(spec_draft.config, self.tp, "draft")
         if spec_draft is not None:
             d_cfg = spec_draft.config
             if getattr(d_cfg, "sliding_window", None):
@@ -442,6 +608,14 @@ class ServingEngine:
 
         self.max_context = int(max_context
                                or cfg.max_position_embeddings)
+        if self.tp > 1:
+            with MeshScope(self.mesh):
+                if _tp_shard_params(model) == 0:
+                    raise ValueError(
+                        "tp>1 needs a tensor-parallel model: build it "
+                        "with config.tensor_parallel=True (Column/Row-"
+                        "parallel layers) — this model has no mp layers "
+                        "to shard")
         self._p_vals = [p._value for _, p in model.named_parameters()]
         cache_dtype = self._p_vals[0].dtype
         s = self.config.num_slots
@@ -457,12 +631,19 @@ class ServingEngine:
         self.pool = PagedKVCachePool(
             num_blocks, bs, cfg.num_key_value_heads, cfg.head_dim,
             num_layers=cfg.num_hidden_layers, dtype=cache_dtype,
-            prefix_cache=self.prefix_cache)
+            prefix_cache=self.prefix_cache, mesh=self.mesh)
         # masked (retired/empty) rows dump their KV writes here
         self._scratch_block = self.pool.ensure("__scratch__", 1)[0]
         self.d_pool = None
         if spec_draft is not None:
             spec_draft.eval()
+            if self.tp > 1:
+                with MeshScope(self.mesh):
+                    if _tp_shard_params(spec_draft) == 0:
+                        raise ValueError(
+                            "tp>1 needs a tensor-parallel DRAFT model: "
+                            "build it with config.tensor_parallel=True "
+                            "— this draft has no mp layers to shard")
             self._d_p_vals = [p._value
                               for _, p in spec_draft.named_parameters()]
             d_cfg = spec_draft.config
@@ -470,7 +651,7 @@ class ServingEngine:
                 num_blocks, bs, d_cfg.num_key_value_heads,
                 d_cfg.head_dim, num_layers=d_cfg.num_hidden_layers,
                 dtype=self._d_p_vals[0].dtype,
-                prefix_cache=self.prefix_cache)
+                prefix_cache=self.prefix_cache, mesh=self.mesh)
             self._d_scratch_block = self.d_pool.ensure("__scratch__",
                                                        1)[0]
         self.scheduler = Scheduler(
@@ -519,12 +700,47 @@ class ServingEngine:
                 self._quantum,
                 n_donatable=2 * (cfg.num_hidden_layers
                                  + d_cfg.num_hidden_layers),
-                name="speculative_verify_step")
+                name="speculative_verify_step", mesh=self.mesh)
         else:
             self._quantum = jax.jit(self._make_quantum(),
                                     donate_argnums=(0, 1))
             self._audited = _AuditedStep(
-                self._quantum, n_donatable=2 * cfg.num_hidden_layers)
+                self._quantum, n_donatable=2 * cfg.num_hidden_layers,
+                mesh=self.mesh)
+        # under tp the small per-slot state rides every dispatch
+        # committed replicated, so the compiled quantum's input layouts
+        # are pinned (never re-inferred per call)
+        self._rep_sharding = None
+        if self.tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._rep_sharding = NamedSharding(self.mesh,
+                                               PartitionSpec())
+        # build-time collective census (tp > 1 only): lower + compile
+        # the quantum ONCE under the mesh, census the post-GSPMD module
+        # for the obs gauges, and KEEP the compiled executable as the
+        # dispatch target — the census compile IS the engine's compile,
+        # so the profile costs no extra compile and needs no runtime
+        # callbacks. tp=1 engines honestly report zeros (their recipes
+        # already pin max_total_collectives=0).
+        self._quantum_compiled = None
+        self.quantum_collectives = {"tp": self.tp, "count_total": 0,
+                                    "bytes_total": 0, "by_kind": {}}
+        if self.tp > 1:
+            from ..analysis.collectives import collective_census
+
+            with MeshScope(self.mesh):
+                self._quantum_compiled = self._quantum.lower(
+                    *self._quantum_args()).compile()
+            census = collective_census(self._quantum_compiled.as_text())
+            by_kind = {k: {"count": st.count, "bytes": st.bytes}
+                       for k, st in census.items() if st.count}
+            self.quantum_collectives = {
+                "tp": self.tp,
+                "count_total": sum(d["count"] for d in by_kind.values()),
+                "bytes_total": sum(d["bytes"] for d in by_kind.values()),
+                "by_kind": by_kind,
+            }
         self.completed: list = []
         # observability: metrics registry (always on unless "off") +
         # optional tracer; `stats` is the legacy dict READ/WRITE view
@@ -541,6 +757,9 @@ class ServingEngine:
                 self.obs.tracer = TraceRecorder()
         self._now = self.obs.now
         self.stats = self.obs.legacy_stats_view()
+        # static per-build collective profile -> registry gauges (zeros
+        # suppressed; a tp=1 engine leaves the series empty)
+        self.obs.set_quantum_collectives(self.quantum_collectives)
         # cost-ledger MFU constants (obs/attribution.py): target-model
         # FLOPs per decoded token (2N weight-matmul floor, embedding
         # gathers excluded) and the chip peak (0.0 off TPU — the MFU
@@ -682,6 +901,13 @@ class ServingEngine:
     def engine_stats(self):
         out = dict(self.stats)
         out["pool"] = self.pool.fragmentation_stats()
+        out["tp"] = self.tp
+        out["quantum_collectives"] = {
+            k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in self.quantum_collectives.items()}
+        if self.tp > 1:
+            out["pool_bytes_per_chip"] = \
+                self.pool.per_chip_bytes_in_use()
         out["admitted"] = self.scheduler.admitted_total
         out["finished"] = self.scheduler.finished_total
         out["preempted"] = self.scheduler.preempted_total
@@ -821,7 +1047,11 @@ class ServingEngine:
             use_neox_rotary_style=True,  # the model's rope layout
             num_heads=h, kv_num_heads=hk, head_dim=d,
         )
-        with autograd.no_grad():
+        # under tp the eager prefill layers place their activations via
+        # the mp layers' constraints, which read the global mesh
+        scope = (MeshScope(self.mesh) if self.mesh is not None
+                 else contextlib.nullcontext())
+        with scope, autograd.no_grad():
             core = model.llama
             hidden = core.embed_tokens(
                 paddle.to_tensor(ids[None, :]))          # (1, T, E)
@@ -841,10 +1071,12 @@ class ServingEngine:
                 hidden = hidden + layer.mlp(
                     layer.post_attention_layernorm(hidden))
             hidden = core.norm(hidden)
-        # the mutated pool Tensors are the new truth
+        # the mutated pool Tensors are the new truth (re-pinned to the
+        # pool's mesh layout under tp — the quantum donates them and
+        # expects the exact layout it was compiled for)
         for i in range(cfg.num_hidden_layers):
-            pool.k_pools[i] = kc_t[i]._value
-            pool.v_pools[i] = vc_t[i]._value
+            pool.k_pools[i] = pool._pin(kc_t[i]._value)
+            pool.v_pools[i] = pool._pin(vc_t[i]._value)
         return hidden
 
     def _mixed_step(self):
@@ -931,7 +1163,9 @@ class ServingEngine:
                 (req.prefill_pos + this_time[i] >= req.prefill_target)]
         if need:
             last_idx = np.asarray([cu[i + 1] - 1 for i in need], np.int32)
-            with autograd.no_grad():
+            scope = (MeshScope(self.mesh) if self.mesh is not None
+                     else contextlib.nullcontext())
+            with scope, autograd.no_grad():
                 hs = Tensor(hidden._value[0, last_idx],
                             stop_gradient=True)
                 logits = model.lm_head(hs)._value        # (R, V)
@@ -1104,28 +1338,52 @@ class ServingEngine:
 
         return quantum
 
+    def _dev(self, a):
+        """Device view of one host mirror: plain uncommitted transfer on
+        a single chip; committed REPLICATED under tp, so every dispatch
+        hands the compiled quantum the exact input layouts it was built
+        for."""
+        v = jnp.asarray(a)
+        if self._rep_sharding is None:
+            return v
+        return jax.device_put(v, self._rep_sharding)
+
     def _quantum_args(self):
         if self.spec_draft is not None:
             return (list(self.pool.k_pools), list(self.pool.v_pools),
                     list(self.d_pool.k_pools),
                     list(self.d_pool.v_pools),
                     self._p_vals, self._d_p_vals,
-                    jnp.asarray(self._tables),
-                    jnp.asarray(self._d_tables),
-                    jnp.asarray(self._seq_lens),
-                    jnp.asarray(self._last_tok),
-                    jnp.asarray(self._n_gen), jnp.asarray(self._done),
-                    jnp.asarray(self._max_new),
-                    jnp.asarray(self._keys))
+                    self._dev(self._tables),
+                    self._dev(self._d_tables),
+                    self._dev(self._seq_lens),
+                    self._dev(self._last_tok),
+                    self._dev(self._n_gen), self._dev(self._done),
+                    self._dev(self._max_new),
+                    self._dev(self._keys))
         args = (list(self.pool.k_pools), list(self.pool.v_pools),
-                self._p_vals, jnp.asarray(self._tables),
-                jnp.asarray(self._seq_lens),
-                jnp.asarray(self._last_tok), jnp.asarray(self._n_gen),
-                jnp.asarray(self._done), jnp.asarray(self._max_new),
-                jnp.asarray(self._keys))
+                self._p_vals, self._dev(self._tables),
+                self._dev(self._seq_lens),
+                self._dev(self._last_tok), self._dev(self._n_gen),
+                self._dev(self._done), self._dev(self._max_new),
+                self._dev(self._keys))
         if self._per_request_sampling:
-            args = args + (jnp.asarray(self._temps),)
+            args = args + (self._dev(self._temps),)
         return args
+
+    def _dispatch_quantum(self):
+        """Run ONE quantum dispatch. Single chip: the jitted callable,
+        exactly as before. Under tp: inside the engine's MeshScope
+        (the first call's trace needs the mesh installed for the mp
+        layers' constraints) and through the build-time compiled
+        executable when present — the census compile doubles as the
+        serving executable."""
+        if self.mesh is None:
+            return self._quantum(*self._quantum_args())
+        with MeshScope(self.mesh):
+            if self._quantum_compiled is not None:
+                return self._quantum_compiled(*self._quantum_args())
+            return self._quantum(*self._quantum_args())
 
     def _spec_round_step(self):
         """Dispatch ONE jitted speculative round (draft-γ scan + target
@@ -1154,7 +1412,7 @@ class ServingEngine:
                     [req.req_id], pad_to=self._table_width)
                 tables[slot] = np.asarray(row)[0][:self._table_width]
         (t_kc, t_vc, d_kc, d_vc, seq_lens, last_tok, n_gen, done,
-         stream, counts, acc) = self._quantum(*self._quantum_args())
+         stream, counts, acc) = self._dispatch_quantum()
         self.pool.k_pools = list(t_kc)
         self.pool.v_pools = list(t_vc)
         self.d_pool.k_pools = list(d_kc)
@@ -1213,8 +1471,8 @@ class ServingEngine:
             row = self.pool.block_table_array(
                 [req.req_id], pad_to=self._table_width)
             self._tables[slot] = np.asarray(row)[0][:self._table_width]
-        kc, vc, seq_lens, last_tok, n_gen, done, toks = self._quantum(
-            *self._quantum_args())
+        kc, vc, seq_lens, last_tok, n_gen, done, toks = \
+            self._dispatch_quantum()
         self.pool.k_pools = list(kc)
         self.pool.v_pools = list(vc)
         toks = np.asarray(toks)                          # (T, S) sync

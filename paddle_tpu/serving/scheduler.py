@@ -33,6 +33,17 @@ table growth plus pending copy-on-write debt, and the candidate's
 can reclaim (minus the matched blocks this admission pins). With the
 cache off (the default) the check reduces byte-for-byte to the static
 worst-case reservation above.
+
+TENSOR PARALLELISM: block tables, refcounts, reservations and the
+admission math are indexed in BLOCKS, never bytes — and a tp-sharded
+pool (nlp/paged_cache.py ``mesh=``) splits each block's kv-head axis
+across chips without changing block count or identity. Every policy in
+this module (priority admission, preemption, prefix-aware fit checks)
+is therefore layout-invariant under ``tp>1``: the same table entry
+simply addresses 1/tp of the heads on each chip, which is what keeps
+prefix aliasing and COW correct on the mesh with zero scheduler
+changes (the mesh-pool adversarial suite in tests/test_serving_tp.py
+re-proves the refcount invariants on the sharded layout).
 """
 from __future__ import annotations
 

@@ -42,6 +42,15 @@ The engine jits this with the draft AND target pool buffers donated
 (``donate_argnums=(0, 1, 2, 3)``); the compiled program is pinned by
 the ``speculative_verify_step`` analysis budget (0 involuntary remat,
 0 host syncs, 0 collectives, bf16 stays bf16, both pools donated).
+
+TENSOR PARALLELISM: the round needs no code of its own — it is built
+from the SAME ``paged_decode_math`` / ``paged_chunk_math`` the plain
+quantum scans, whose KV writes re-pin the kv-head sharding under an
+installed mesh (engine.py ``_pin_kv``). When the engine runs ``tp>1``
+both models' params are mesh-sharded at build, BOTH paged pools carry
+the kv-head split, and the whole draft+verify round stays one dispatch
+whose collectives live in-graph — the ``serving_tp_step`` recipe's
+census caps and the tp2 parity tests pin that shape.
 """
 from __future__ import annotations
 

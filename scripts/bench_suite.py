@@ -14,7 +14,7 @@ llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
 serving_engine | speculative_decode | speculative_serving |
 serving_obs_overhead | attribution_overhead | slo_overhead |
 serving_overload |
-shared_prefix
+shared_prefix | serving_tp
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -1011,6 +1011,15 @@ def shared_prefix():
     return _bench_serving().shared_prefix()
 
 
+def serving_tp():
+    """TP-sharded serving acceptance row (ISSUE 11): the same weights
+    and request set through tp=1 vs tp=2 engines — streams must be
+    bit-identical, per-chip KV pool residency halves (the guarded
+    2.0x ratio), quantum step time + collective census ride along
+    (see scripts/bench_serving.py, artifact BENCH_TP_r13.json)."""
+    return _bench_serving().serving_tp()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -1022,6 +1031,7 @@ CONFIGS = {
     "slo_overhead": slo_overhead,
     "serving_overload": serving_overload,
     "shared_prefix": shared_prefix,
+    "serving_tp": serving_tp,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

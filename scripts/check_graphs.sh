@@ -46,10 +46,21 @@ python -m paddle_tpu.analysis --check --fingerprint
 # `obs check` runs the prefix smoke: forced hit/COW must fire the
 # serving_prefix_cache_* counters, streams must stay bit-identical to
 # an unshared engine, and the dashboard must render the prefix line.
+# TP-serving gate (ISSUE 11): `--check --fingerprint` above also
+# audits `serving_tp_step` — the tp=2 quantum on the ("mp",) mesh:
+# params head/ffn-sharded through the training recipes' mp layers, KV
+# pool leaves split along kv heads, still ONE dispatch with in-graph
+# collectives. Its budget pins the collective census (<=8 ops /
+# <=46 KB per quantum), demands the pool leaves CARRY the mp axis
+# (min_sharded_params=4, max_replicated_param_bytes=0) and keeps 0
+# host callbacks + donation; the tp=1 recipes' goldens must stay
+# byte-identical (the mesh enters only through the tp recipe). The
+# CLI re-execs with 8 virtual CPU devices when the host exposes fewer.
 python -m paddle_tpu.obs check
 # Perf sentinel (ISSUE 10): the runtime twin of the graph gate —
 # validate/index the BENCH_*.json trajectory and enforce the declared
 # PerfBudget bands (spec >=1.1x, shed-arm p95 bound >=1.5x, prefix
-# prefill-token ratio >=2x, obs/SLO/attribution overhead <3%, ...).
+# prefill-token ratio >=2x, tp per-chip pool residency 2.0x,
+# obs/SLO/attribution overhead <3%, ...).
 scripts/check_perf.sh
 echo "check_graphs: lint + budgets + fingerprints (+obs +perf) all green"

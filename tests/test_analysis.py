@@ -326,14 +326,51 @@ def test_memory_budget_caps_enforced():
 # -------------------------------------------------------------- sharding
 
 def test_sharding_attr_classification():
+    """_classify returns (replicated, unknown): every recognized syntax
+    parses with unknown=False; unrecognized syntax is classified
+    replicated (strict fallback) but COUNTED unknown so a report can
+    tell a parser gap from an actually-replicated leaf."""
     from paddle_tpu.analysis.sharding import _classify
 
-    assert _classify("") and _classify(None)
-    assert _classify("{replicated}")
-    assert _classify("{maximal device=0}")
-    assert _classify("{devices=[1,1,8]<=[8] last_tile_dim_replicate}")
-    assert not _classify("{devices=[2,4]<=[8]}")
-    assert not _classify("{devices=[2,1,4]<=[8] last_tile_dim_replicate}")
+    assert _classify("") == (True, False)
+    assert _classify(None) == (True, False)
+    assert _classify("{replicated}") == (True, False)
+    assert _classify("{maximal device=0}") == (True, False)
+    assert _classify(
+        "{devices=[1,1,8]<=[8] last_tile_dim_replicate}") == (True, False)
+    assert _classify("{devices=[2,4]<=[8]}") == (False, False)
+    assert _classify(
+        "{devices=[2,1,4]<=[8] last_tile_dim_replicate}") == (False, False)
+    # unknown syntax: strict (replicated) AND counted
+    assert _classify("{v2_tuple_shardings_from_the_future}") == (True, True)
+
+
+def test_sharding_unknown_syntax_counted_in_report():
+    """An entry arg carrying unparseable sharding syntax lands in the
+    report as replicated (the audit stays strict) with unknown_count
+    nonzero — and summary_dict only GROWS the unknown_shardings key in
+    that case, so every existing golden (all-parsed) stays
+    byte-identical."""
+    from paddle_tpu.analysis.sharding import audit_sharding
+
+    hlo = (
+        'func.func public @main('
+        '%arg0: tensor<4x4xf32> {mhlo.sharding = "{devices=[2,1]<=[2]}"}, '
+        '%arg1: tensor<4x4xf32> {mhlo.sharding = "{weird_future_repr}"}, '
+        '%arg2: tensor<4xf32>) -> tensor<4xf32> {'
+    )
+    rep = audit_sharding(hlo)
+    assert rep.sharded_count == 1
+    assert rep.unknown_count == 1
+    unk = [a for a in rep.args if a.unknown]
+    assert len(unk) == 1 and unk[0].replicated  # strict fallback holds
+    assert "unknown syntax" in repr(unk[0])
+    assert rep.summary_dict()["unknown_shardings"] == 1
+    # the common fully-parsed case: key absent -> goldens untouched
+    clean = audit_sharding(hlo.replace("{weird_future_repr}",
+                                       "{replicated}"))
+    assert clean.unknown_count == 0
+    assert "unknown_shardings" not in clean.summary_dict()
 
 
 def test_sharding_pass_flags_replicated_param():
