@@ -371,6 +371,15 @@ def default_perf_budgets():
                    "the same instrumented engine (<3% bar; observed "
                    "1.5%) — the attribution layer prices itself"),
         PerfBudget(
+            "fault-recovery-overhead", "BENCH_RESILIENCE_r14.json",
+            "serving_fault_recovery_overhead_pct_cpu_smoke",
+            ceiling=3.0, noise_frac=0.0,
+            reason="guarded dispatch + watchdog + pool audit with the "
+                   "injector disarmed vs the plain obs='off' engine "
+                   "(<3% bar; observed -2.2%..0.5% across runs, "
+                   "i.e. in the noise) — containment must be free "
+                   "until a fault fires"),
+        PerfBudget(
             "quantum-vs-batch1", "BENCH_SERVING_r06.json",
             "serving_engine_ragged_tokens_per_sec_cpu_smoke",
             field="quantum_speedup_vs_batch1",

@@ -183,7 +183,7 @@ def _build_serving_decode_step():
     import numpy as np
     import paddle_tpu as paddle
     from ..nlp import LlamaConfig, LlamaForCausalLM
-    from ..serving import ServingEngine
+    from ..serving import FaultInjector, ServingEngine
 
     paddle.seed(0)
     cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
@@ -193,9 +193,15 @@ def _build_serving_decode_step():
     # audited program and its golden fingerprint must be byte-identical
     # to the uninstrumented engine — this recipe IS that proof (tier-1
     # + `python -m paddle_tpu.obs check` + scripts/check_graphs.sh)
+    # resilience tier on with a DISARMED injector: the watchdog,
+    # retry policy and fault hooks are host-side no-ops until a plan
+    # arms them, so this golden also pins that the resilience tier
+    # cannot perturb the compiled quantum
     engine = ServingEngine(model, num_slots=2, block_size=4,
                            prefill_chunk=8, decode_quantum=4,
-                           trace=True, slo=True, flight=True)
+                           trace=True, slo=True, flight=True,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
     rng = np.random.RandomState(0)
     engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
                   max_new_tokens=8)
@@ -223,7 +229,7 @@ def _build_speculative_verify_step():
     import numpy as np
     import paddle_tpu as paddle
     from ..nlp import LlamaConfig, LlamaForCausalLM
-    from ..serving import ServingEngine
+    from ..serving import FaultInjector, ServingEngine
 
     paddle.seed(0)
     cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
@@ -235,7 +241,9 @@ def _build_speculative_verify_step():
     # serving_decode_step
     engine = ServingEngine(target, spec_draft=draft, spec_gamma=2,
                            num_slots=2, block_size=4, prefill_chunk=8,
-                           trace=True, slo=True, flight=True)
+                           trace=True, slo=True, flight=True,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
     rng = np.random.RandomState(0)
     engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
                   max_new_tokens=6)
@@ -263,8 +271,8 @@ def _build_serving_frontdoor_step():
     import paddle_tpu as paddle
     from ..nlp import LlamaConfig, LlamaForCausalLM
     from ..serving import (
-        BATCH, INTERACTIVE, FrontDoorPolicy, ServingEngine,
-        ServingFrontDoor,
+        BATCH, INTERACTIVE, FaultInjector, FrontDoorPolicy,
+        ServingEngine, ServingFrontDoor,
     )
 
     paddle.seed(0)
@@ -279,7 +287,9 @@ def _build_serving_frontdoor_step():
                            prefill_chunk=8, decode_quantum=4,
                            decode_strategy="sampling", top_k=8,
                            per_request_sampling=True,
-                           trace=True, slo=True, flight=True)
+                           trace=True, slo=True, flight=True,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
     door = ServingFrontDoor(engine, policy=FrontDoorPolicy())
     rng = np.random.RandomState(0)
     low = door.submit(rng.randint(1, cfg.vocab_size, 6)
@@ -317,7 +327,7 @@ def _build_serving_prefix_step():
     import numpy as np
     import paddle_tpu as paddle
     from ..nlp import LlamaConfig, LlamaForCausalLM
-    from ..serving import ServingEngine
+    from ..serving import FaultInjector, ServingEngine
 
     paddle.seed(0)
     cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
@@ -335,7 +345,9 @@ def _build_serving_prefix_step():
     engine = ServingEngine(model, num_slots=2, block_size=4,
                            prefill_chunk=8, decode_quantum=4,
                            prefix_cache=True,
-                           trace=True, slo=True, flight=True)
+                           trace=True, slo=True, flight=True,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
     rng = np.random.RandomState(0)
     prompt = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
     engine.submit(prompt.copy(), max_new_tokens=8)
@@ -366,7 +378,7 @@ def _build_serving_tp_step():
     import numpy as np
     import paddle_tpu as paddle
     from ..nlp import LlamaConfig, LlamaForCausalLM
-    from ..serving import ServingEngine
+    from ..serving import FaultInjector, ServingEngine
 
     paddle.seed(0)
     cfg = LlamaConfig.tiny(tensor_parallel=True, dtype="bfloat16")
@@ -381,7 +393,9 @@ def _build_serving_tp_step():
     # mesh enters only through this builder's engine.
     engine = ServingEngine(model, num_slots=2, block_size=4,
                            prefill_chunk=8, decode_quantum=4,
-                           trace=True, slo=True, flight=True, tp=2)
+                           trace=True, slo=True, flight=True, tp=2,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
     rng = np.random.RandomState(0)
     engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
                   max_new_tokens=8)

@@ -181,6 +181,17 @@ def create_serving_engine(model, **kwargs):
     Per-request knobs ride ``engine.submit`` — priority, temperature,
     stop_token_ids, stop_sequences, max_new_tokens, seed.
 
+    RESILIENCE: ``resilience=True`` (or a
+    :class:`~paddle_tpu.serving.ResiliencePolicy`) arms the per-quantum
+    watchdog, injected-fault retry with backoff, batch-bisect poison
+    quarantine, the degradation ladders (spec auto-disable, prefix
+    quarantine, pool accounting rebuild), and snapshot-based crash
+    recovery (``engine.snapshot()`` / ``ServingEngine.restore()``);
+    ``faults=`` threads a seeded
+    :class:`~paddle_tpu.serving.FaultInjector` through the host
+    boundaries for deterministic chaos testing (default disarmed —
+    byte-identical goldens).
+
     TENSOR-PARALLEL SERVING: pass ``tp=2`` (or an explicit ``mesh=``
     with an ``"mp"`` axis) to shard the whole quantum family over the
     device mesh — params split along heads/ffn, paged KV pools split
@@ -219,7 +230,12 @@ def serve(model, policy=None, slo=True, flight=True, **kwargs):
     per-request win. ``tp=2`` / ``mesh=`` shard the engine's quantum
     over the device mesh (tensor-parallel model required; streams stay
     bit-exact — :func:`create_serving_engine` documents the setup).
-    Remaining keyword args forward to the engine
+    ``resilience=True`` arms the watchdog/retry/quarantine tier and
+    makes the front door crash-recoverable
+    (``fd.snapshot()`` / ``ServingFrontDoor.restore(snap, model)``
+    re-opens every in-flight stream via recompute-on-resume);
+    ``submit(..., timeout=)`` bounds each token wait. Remaining
+    keyword args forward to the engine
     (:func:`create_serving_engine` documents them).
 
     ::

@@ -26,6 +26,8 @@ pressure and only at refcount one (no live holder).
 """
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -129,6 +131,18 @@ class PagedKVCachePool:
         self.cow_copies = 0              # copy-on-write block copies
         self.prefix_aliases = 0          # share() aliases the index created
         self.prefix_evictions = 0        # entries reclaimed under pressure
+        # resilience tier (serving/faults.py + engine resilience=):
+        # fault_hook fires inside _alloc_block (deterministic injected
+        # allocation failures); kv_checksums arms the chain-hash
+        # CONTENT verify — publish records a per-block checksum,
+        # attach_prefix re-verifies before aliasing and QUARANTINES a
+        # corrupted subtree; accounting_rebuilds counts degraded-mode
+        # recoveries from refcount drift
+        self.fault_hook = None
+        self.kv_checksums = False
+        self._block_crcs: dict = {}      # block id -> publish-time crc
+        self.prefix_quarantines = 0      # entries dropped by verify
+        self.accounting_rebuilds = 0
         if prefix_cache:
             self.enable_prefix_cache()
 
@@ -143,6 +157,10 @@ class PagedKVCachePool:
         mid-operation (e.g. during a COW device copy, which allocates
         and then copies layer by layer) can never observe an
         allocated-but-unaccounted block."""
+        if self.fault_hook is not None:
+            # deterministic fault injection: a raised hook fires BEFORE
+            # any state changes, so the caller can simply retry
+            self.fault_hook(self)
         if not self._free:
             self.evict_prefix(1)
         if not self._free:
@@ -258,6 +276,8 @@ class PagedKVCachePool:
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already exists")
         entries = self._match_entries(tokens, max_blocks=max_blocks)
+        if self.kv_checksums:
+            entries = self._verify_entries(entries)
         self.prefix_hits += len(entries)
         self.prefix_misses += max(
             self._full_blocks(tokens) - len(entries), 0)
@@ -312,6 +332,8 @@ class PagedKVCachePool:
                 self._refcounts[blk] += 1
                 if parent is not None:
                     parent.nchildren += 1
+                if self.kv_checksums:
+                    self._block_crcs[blk] = self._block_crc(blk)
                 published += 1
             else:
                 hit.tick = self._prefix_tick
@@ -367,8 +389,85 @@ class PagedKVCachePool:
         if e.parent is not None:
             e.parent.nchildren -= 1
         del self._cached_blocks[e.block]
+        self._block_crcs.pop(e.block, None)
         self._release([e.block])
         self.prefix_evictions += 1
+
+    # -- resilience: content verify + degraded-mode recovery ---------------
+    def _block_crc(self, blk):
+        """Publish-time content checksum of one cached block: crc32
+        over the layer-0 K rows (cheap; a cached block's pool content
+        is immutable while cached — any write COWs first — so a
+        mismatch at attach time means real corruption)."""
+        return zlib.crc32(np.asarray(self.k_pools[0][blk]).tobytes())
+
+    def _verify_entries(self, entries):
+        """Chain-hash verify-mismatch ladder: re-checksum each matched
+        cached block before aliasing it; the FIRST mismatch quarantines
+        that entry's whole subtree (a corrupted parent poisons every
+        descendant's content lineage) and truncates the match there —
+        the sequence continues UNSHARED from that depth."""
+        for i, e in enumerate(entries):
+            want = self._block_crcs.get(e.block)
+            if want is None or self._block_crc(e.block) == want:
+                continue
+            self.quarantine_prefix(e)
+            return entries[:i]
+        return entries
+
+    def quarantine_prefix(self, entry):
+        """Drop ``entry`` and every descendant from the prefix index
+        (live sequences that already alias the blocks keep their
+        refcounted holds — only the index's holds release). Returns
+        the number of entries quarantined."""
+        doomed = {id(entry): entry}
+        changed = True
+        while changed:
+            changed = False
+            for e in self._cached_blocks.values():
+                if id(e) in doomed:
+                    continue
+                if e.parent is not None and id(e.parent) in doomed:
+                    doomed[id(e)] = e
+                    changed = True
+        remaining = list(doomed.values())
+        while remaining:
+            leaves = [e for e in remaining if e.nchildren == 0]
+            if not leaves:  # chains are trees; cannot happen
+                raise RuntimeError("prefix subtree has no leaf")
+            for e in leaves:
+                self._drop_entry(e)
+                remaining.remove(e)
+        self.prefix_quarantines += len(doomed)
+        return len(doomed)
+
+    def rebuild_accounting(self):
+        """Degraded-mode recovery from accounting drift: rebuild the
+        refcount map and free list from the LIVE BLOCK TABLES — the
+        only ownership structure tied to real sequence state — and
+        conservatively drop the whole prefix index (cached subtrees
+        cannot be trusted after drift; no ``_release`` walk, the index
+        holds are simply forgotten). ``_check_accounting`` passes by
+        construction afterwards. Returns a summary dict."""
+        counts: dict = {}
+        for blocks in self._tables.values():
+            for b in blocks:
+                counts[b] = counts.get(b, 0) + 1
+        dropped_entries = len(self._cached_blocks)
+        self._prefix_buckets = {}
+        self._cached_blocks = {}
+        self._block_crcs = {}
+        self._refcounts = dict(counts)
+        held = set(counts)
+        self._free = [b for b in range(self.num_blocks - 1, -1, -1)
+                      if b not in held]
+        for s in list(self._lens):
+            if s not in self._tables:
+                del self._lens[s]
+        self.accounting_rebuilds += 1
+        return {"held_blocks": len(held),
+                "free_blocks": len(self._free),
+                "dropped_prefix_entries": dropped_entries}
 
     def evict_prefix(self, n):
         """Reclaim up to ``n`` cached blocks under allocation pressure:

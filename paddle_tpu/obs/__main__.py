@@ -46,7 +46,10 @@ bit-identical to an unshared engine, and render the dashboard's
 prefix line), and the ATTRIBUTION smoke (ISSUE 10: the cost ledger
 must conserve — phase token buckets sum to the emitted-token counter
 token-for-token, and per-phase seconds sum to the measured quantum
-walls within float tolerance). Exit non-zero on drift.
+walls within float tolerance), and the RESILIENCE smoke (ISSUE 13: a
+bounded seeded chaos soak — faults x preemption x COW — must keep
+every non-poisoned stream bit-exact vs the fault-free arm with zero
+leaked blocks). Exit non-zero on drift.
 """
 from __future__ import annotations
 
@@ -498,6 +501,41 @@ def _check_attribution_smoke():
           f"{attributed:.3f}s attributed == quantum wall")
 
 
+def _check_resilience_smoke():
+    """The chaos-soak smoke (ISSUE 13): a bounded seeded run of the
+    two-arm resilience soak — same workload fault-free and under an
+    armed injector + seeded preemptions — asserting faults actually
+    fired and every non-poisoned stream stayed bit-exact. run_soak
+    hard-asserts drain, definite finish reasons and zero leaked blocks
+    internally; replay any failure from the printed seed alone."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving.soak import run_soak
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+    model.eval()
+    # 6 rounds keeps the smoke under the eager mixed-prefill budget
+    # (~30 s on CPU) while still landing a couple of injected faults
+    rep = run_soak(model, rounds=6, seed=2)
+    if rep["faults_injected"] < 1:
+        raise AssertionError(
+            f"soak injected no faults — plan/seed drifted: {rep}")
+    if rep["requests"] < 1:
+        raise AssertionError(f"soak submitted nothing: {rep}")
+    expect_exact = rep["requests"] - len(rep["poisoned"])
+    if rep["bitexact_streams"] != expect_exact:
+        raise AssertionError(
+            f"soak lost streams: {rep['bitexact_streams']} bit-exact "
+            f"of {expect_exact} non-poisoned")
+    print(f"resilience smoke: seed={rep['seed']} "
+          f"rounds={rep['rounds']} requests={rep['requests']} "
+          f"faults={rep['faults_injected']} "
+          f"retries={rep['retries']} skips={rep['step_skips']}, "
+          f"{rep['bitexact_streams']} non-poisoned streams bit-exact, "
+          f"pools drained clean")
+
+
 def _cmd_check(args):
     """Instrumented-fingerprint gate: the serving recipes construct
     their engines with full observability ON (analysis/recipes.py);
@@ -555,6 +593,11 @@ def _cmd_check(args):
     except (AssertionError, ValueError, KeyError) as e:
         failed = True
         print(f"attribution smoke: FAIL — {e}", file=sys.stderr)
+    try:
+        _check_resilience_smoke()
+    except (AssertionError, ValueError, RuntimeError) as e:
+        failed = True
+        print(f"resilience smoke: FAIL — {e}", file=sys.stderr)
     if failed:
         return 1
     print("obs check: instrumentation-enabled fingerprints unchanged")

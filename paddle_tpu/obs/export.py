@@ -67,6 +67,11 @@ class MetricsExporter:
         self.port = int(port)
         self._server = None
         self._thread = None
+        # broken renders must not kill the endpoint OR pass silently:
+        # every handler exception turns into a JSON 500 and a bump here
+        self._c_errors = registry.counter(
+            "exporter_errors_total",
+            "handler exceptions turned into HTTP 500 responses")
 
     @classmethod
     def for_engine(cls, engine, host="127.0.0.1", port=0):
@@ -193,8 +198,15 @@ def _make_handler(exporter):
                         + " ".join(exporter.routes()) + "\n",
                         "text/plain")
             except Exception as e:  # a broken render must not kill the
-                self._send(500, f"{type(e).__name__}: {e}\n",
-                           "text/plain")  # server thread
+                exporter._c_errors.inc()  # server thread
+                try:
+                    self._send(
+                        500,
+                        json.dumps({"error": f"{type(e).__name__}: {e}"},
+                                   sort_keys=True) + "\n",
+                        "application/json")
+                except Exception:
+                    pass  # client hung up mid-error; nothing to do
 
     return _Handler
 
@@ -217,6 +229,25 @@ def _snap_value(snap, name, default=0.0, **labels):
                 == want:
             return s.get("value", default)
     return default
+
+
+def _snap_sum(snap, name):
+    """Sum of a metric's series across ALL label sets (e.g. the total
+    of a ``{site,kind}``-labeled counter)."""
+    m = _snap_metric(snap, name)
+    if m is None:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in m["series"])
+
+
+def _snap_labels_where(snap, name, pred):
+    """Label dicts of a metric's series whose value satisfies
+    ``pred`` — e.g. the active modes of the degraded-mode gauge."""
+    m = _snap_metric(snap, name)
+    if m is None:
+        return []
+    return [s.get("labels", {}) for s in m["series"]
+            if pred(s.get("value", 0.0))]
 
 
 def _snap_quantile(snap, name, q):
@@ -335,6 +366,22 @@ def render_dashboard(snapshot, report=None, width=62):
             lines.append(
                 f" prefix[{pool:<4}] hits {hits:>6.0f}  misses "
                 f"{misses:>6.0f}  cow {cow:>4.0f}  cached {frac:6.1%}")
+    # resilience line — only once a fault/retry/trip/quarantine has
+    # happened, so pre-resilience snapshots render as before
+    faults = _snap_sum(snapshot, "serving_faults_injected_total")
+    retries = _snap_sum(snapshot, "serving_quantum_retries_total")
+    trips = _snap_sum(snapshot, "serving_watchdog_trips_total")
+    quar = _snap_sum(snapshot, "serving_quarantines_total")
+    restores = _snap_sum(snapshot, "serving_restores_total")
+    if faults or retries or trips or quar or restores:
+        lines.append(
+            f" faults    injected {faults:>5.0f}  retries {retries:>4.0f}"
+            f"  watchdog {trips:>4.0f}  quarantined {quar:>4.0f}"
+            f"  restores {restores:>3.0f}")
+    modes = sorted(lb.get("mode", "?") for lb in _snap_labels_where(
+        snapshot, "serving_degraded_mode", lambda v: v >= 1.0))
+    if modes:
+        lines.append(f" degraded  {', '.join(modes)}")
     coll_bytes = g("serving_collective_bytes_total")
     if coll_bytes:
         lines.append(

@@ -2,7 +2,8 @@
 event a request passes through on the host scheduler — submit, admit
 (with pool/block context), prefill chunks, first token, decode-quantum
 yields, speculative rounds with acceptance, preempt/resume (the front
-door's eviction pair, with the recompute debt), retire — with
+door's eviction pair, with the recompute debt), the resilience tier's
+fault/retry/degrade/restore events (serving/faults.py), retire — with
 DUMP-ON-ANOMALY: when a retiring request's TTFT or e2e latency crosses
 its SLO threshold (obs/slo.py), or its preemptions re-computed more
 cached tokens than ``recompute_threshold`` allows (the cost ledger's
@@ -35,7 +36,7 @@ __all__ = ["FlightRecorder", "validate_flight_records",
 
 EVENT_KINDS = ("submit", "admit", "prefill_chunk", "first_token",
                "decode_quantum", "spec_round", "preempt", "resume",
-               "shed", "retire")
+               "shed", "retire", "fault", "retry", "degrade", "restore")
 
 _ANOMALY_SIGNALS = ("ttft_seconds", "e2e_latency_seconds")
 
@@ -173,6 +174,37 @@ class FlightRecorder:
                     slot=(None if slot is None else int(slot)),
                     prefill_tokens=int(prefill_tokens),
                     preemptions=int(req.preemptions))
+
+    def on_fault(self, req, t, site=None, kind=None):
+        """An injected (or contained) fault touched this request —
+        either a fault fired while the request was an active dispatch
+        row, or the bisect quarantine error-finished it
+        (``site="quarantine"``). The fault's own kind rides in the
+        ``fault`` field (``kind`` is the event kind)."""
+        self._event(req, "fault", t, site=site, fault=kind)
+
+    def on_retry(self, req, t, kind=None, attempt=None, backoff_s=None):
+        """The dispatch this request rode in was retried after an
+        injected fault (``attempt`` is 1-based; the quantum kind rides
+        in ``quantum``)."""
+        self._event(req, "retry", t, quantum=kind,
+                    attempt=(None if attempt is None else int(attempt)),
+                    backoff_s=backoff_s)
+
+    def on_degrade(self, req, t, mode=None):
+        """A degradation-ladder rung activated while this request was
+        live (``spec_disabled`` | ``pool_rebuild``) — journaled per
+        live request so an anomaly dump shows the mode switch inline
+        with the request's own timeline."""
+        self._event(req, "degrade", t, mode=mode)
+
+    def on_restore(self, req, t, tokens_resumed=0):
+        """The request was re-admitted into a restored engine
+        (snapshot -> restore crash recovery): ``tokens_resumed`` tokens
+        were already emitted pre-crash and will be re-prefilled, not
+        re-emitted."""
+        self._event(req, "restore", t,
+                    tokens_resumed=int(tokens_resumed))
 
     def on_shed(self, req, t, reason="shed"):
         """A request refused admission by a load-shedding policy: its

@@ -26,7 +26,14 @@ Exported serving metrics (all host-boundary):
   (serving/frontend.py), the prefix-cache counters
   ``serving_prefix_cache_{hits,misses,cow_copies,shared_blocks}_total``
   ``{pool=target|draft}`` (synced from the pool's monotonic counters
-  at step boundaries when the engine runs ``prefix_cache=True``), plus
+  at step boundaries when the engine runs ``prefix_cache=True``), the
+  resilience counters ``serving_faults_injected_total{site,kind}`` /
+  ``serving_quantum_retries_total{kind}`` /
+  ``serving_watchdog_trips_total{kind}`` /
+  ``serving_degrades_total{mode}`` / ``serving_pool_rebuilds_total`` /
+  ``serving_quarantines_total{kind=poison|prefix}`` /
+  ``serving_restores_total`` (serving/faults.py +
+  serving/resilience.py, all synced at step edges), plus
   the legacy ``serving_*_total`` counters behind ``engine.stats``.
 - histograms: ``serving_queue_wait_seconds``, ``serving_ttft_seconds``
   (observed exactly once per request, at the prefill-completion step
@@ -228,6 +235,36 @@ class ServingObs:
         self._g_pc_frac = r.gauge(
             "serving_prefix_cache_cached_block_fraction",
             "index-held blocks / blocks in use")
+        # resilience tier (serving/faults.py + serving/resilience.py):
+        # injected faults, dispatch retries, watchdog overruns, the
+        # degradation ladder and quarantines, snapshot restores — all
+        # host-boundary events the engine reports at step edges
+        self._c_faults = r.counter(
+            "serving_faults_injected_total",
+            "faults the seeded injector fired, by site/kind")
+        self._c_retries = r.counter(
+            "serving_quantum_retries_total",
+            "quantum dispatches retried after an injected fault")
+        self._c_watchdog = r.counter(
+            "serving_watchdog_trips_total",
+            "quantum dispatches that overran the p99-derived deadline")
+        self._g_degraded = r.gauge(
+            "serving_degraded_mode",
+            "1 while a degraded mode is active, by mode "
+            "(spec_disabled|pool_rebuild)")
+        self._c_degrades = r.counter(
+            "serving_degrades_total",
+            "degradation-ladder activations, by mode")
+        self._c_pool_rebuilds = r.counter(
+            "serving_pool_rebuilds_total",
+            "pool accounting rebuilt from live block tables")
+        self._c_quarantines = r.counter(
+            "serving_quarantines_total",
+            "poison requests error-finished / prefix subtrees dropped, "
+            "by kind")
+        self._c_restores = r.counter(
+            "serving_restores_total",
+            "engines rebuilt from a snapshot (crash recovery)")
         # per-quantum collective census (TP serving): bytes/op counts
         # the ONE jitted quantum moves over mesh collectives, read off
         # the compiled HLO at engine build (analysis/collectives.py).
@@ -520,6 +557,67 @@ class ServingObs:
         rate = accepted / proposed
         self._g_accept.set(rate)
         self._series["spec_acceptance_rate"].append((now, rate))
+
+    # -- resilience hooks --------------------------------------------------
+    def on_fault(self, site, kind):
+        """One injected fault fired (synced from the injector's journal
+        at the step boundary — the injector itself never touches the
+        registry)."""
+        if self.enabled:
+            self._c_faults.inc(site=site, kind=kind)
+
+    def on_retry(self, kind, attempt):
+        """One dispatch retried after an injected fault (``attempt`` is
+        the 1-based retry number; only the count is exported)."""
+        if self.enabled:
+            self._c_retries.inc(kind=kind)
+
+    def on_watchdog(self, kind, elapsed):
+        """One quantum overran its watchdog deadline (detection-only:
+        the dispatch already returned)."""
+        if not self.enabled:
+            return
+        self._c_watchdog.inc(kind=kind)
+        if self.tracer is not None:
+            self.tracer.instant("watchdog_trip", self.now(), tid=0,
+                                args={"kind": kind,
+                                      "elapsed_s": float(elapsed)})
+
+    def on_degrade(self, mode, now):
+        """A degradation-ladder rung activated (``spec_disabled`` |
+        ``pool_rebuild``): the mode gauge latches 1 and the activation
+        counter bumps; pool rebuilds also feed their own counter."""
+        if not self.enabled:
+            return
+        self._g_degraded.set(1.0, mode=mode)
+        self._c_degrades.inc(mode=mode)
+        if mode == "pool_rebuild":
+            self._c_pool_rebuilds.inc()
+        if self.tracer is not None:
+            self.tracer.instant("degrade", now, tid=0,
+                                args={"mode": mode})
+
+    def on_quarantine(self, now, what, count=1):
+        """``what="poison"``: a poison request was isolated by batch
+        bisect and error-finished. ``what="prefix"``: cached prefix
+        entries dropped after a content-verify mismatch."""
+        if not self.enabled:
+            return
+        self._c_quarantines.inc(int(count), kind=what)
+        if self.tracer is not None:
+            self.tracer.instant("quarantine", now, tid=0,
+                                args={"kind": what,
+                                      "count": int(count)})
+
+    def on_restore(self, now, inflight):
+        """An engine was rebuilt from a snapshot, re-admitting
+        ``inflight`` requests via recompute-on-resume."""
+        if not self.enabled:
+            return
+        self._c_restores.inc()
+        if self.tracer is not None:
+            self.tracer.instant("restore", now, tid=0,
+                                args={"inflight": int(inflight)})
 
     def on_cached_prefill(self, req, tokens):
         """Prompt tokens an admission skipped via a prefix-cache alias

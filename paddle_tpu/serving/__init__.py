@@ -29,6 +29,18 @@ refcount one, and the scheduler admits on NOVEL block demand. Streams
 stay bit-identical to the unshared engine; prefill compute scales
 with unique tokens.
 
+RESILIENCE (:mod:`.faults` + :mod:`.resilience`, engine kwargs
+``faults=`` / ``resilience=``): a deterministic seeded
+:class:`FaultInjector` at the host boundaries (default disarmed —
+byte-identical goldens), a p99-calibrated :class:`QuantumWatchdog`
+with exponential-backoff retry, batch-bisect poison quarantine
+(``finish_reason="error"``, everyone else keeps serving), degradation
+ladders (spec auto-disable to the plain quantum, prefix-subtree
+quarantine on content-verify mismatch, pool accounting rebuild from
+live block tables), and crash recovery via ``engine.snapshot()`` /
+``ServingEngine.restore()`` (recompute-on-resume, bit-exact greedy
+continuation) — also exposed on the front door.
+
 The compiled programs are pinned by the ``serving_decode_step`` /
 ``speculative_verify_step`` / ``serving_frontdoor_step`` /
 ``serving_prefix_step`` analysis Budgets (zero involuntary remat,
@@ -45,9 +57,13 @@ from .policy import (
     no_shed_policy,
 )
 from .frontend import ServingFrontDoor, TokenStream
+from .faults import FaultInjector, FaultSpec, InjectedFault
+from .resilience import QuantumWatchdog, ResiliencePolicy
 
 __all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine",
            "make_spec_round",
            "BATCH", "NORMAL", "INTERACTIVE", "FrontDoorPolicy",
            "choose_victim", "no_shed_policy",
-           "ServingFrontDoor", "TokenStream"]
+           "ServingFrontDoor", "TokenStream",
+           "FaultInjector", "FaultSpec", "InjectedFault",
+           "QuantumWatchdog", "ResiliencePolicy"]

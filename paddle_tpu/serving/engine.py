@@ -106,6 +106,8 @@ from ..obs.serving import ServingObs
 from ..obs.slo import SLOSet
 from ..parallel import mesh as mesh_state
 from ..parallel.mesh import MeshScope
+from .faults import FaultInjector, InjectedFault
+from .resilience import QuantumWatchdog, ResiliencePolicy
 from .scheduler import Request, Scheduler, SchedulerConfig
 
 __all__ = ["ServingEngine"]
@@ -542,6 +544,26 @@ class ServingEngine:
             byte-identical to previous releases. On CPU expose virtual
             devices BEFORE jax initializes (e.g.
             ``XLA_FLAGS='--xla_force_host_platform_device_count=8'``).
+        faults: a :class:`~paddle_tpu.serving.faults.FaultInjector`
+            threaded through the engine's host boundaries (quantum
+            dispatch, pool allocation, cached-KV corruption). Default:
+            a fresh DISARMED injector — every hook is a constant-time
+            no-op and all compiled goldens stay byte-identical (the
+            serving recipes build with exactly this to pin it).
+        resilience: ``True`` (stock
+            :class:`~paddle_tpu.serving.resilience.ResiliencePolicy`)
+            or a policy instance arms the resilience tier: injected
+            faults retry with exponential backoff then contain at the
+            step boundary (poison requests are isolated by batch
+            bisect and finished with ``finish_reason="error"``), a
+            wall-clock watchdog self-calibrated from the quantum
+            latency histogram feeds the degradation ladders (repeated
+            spec-round faults fall back to the plain quantum — same
+            compiled family, no new golden), prefix chain-hash content
+            verify quarantines corrupted cached subtrees, and pool
+            accounting drift rebuilds the allocator from the live
+            block tables. Default ``None``: fail-stop exactly as
+            before.
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
@@ -550,7 +572,8 @@ class ServingEngine:
                  temperature=1.0, eos_token_id=None, spec_draft=None,
                  spec_gamma=4, prefix_cache=False,
                  per_request_sampling=False, obs=None,
-                 trace=False, slo=None, flight=None, mesh=None, tp=None):
+                 trace=False, slo=None, flight=None, mesh=None, tp=None,
+                 faults=None, resilience=None):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -790,6 +813,35 @@ class ServingEngine:
             self.flight = None
         else:
             self.flight = flight
+        # resilience tier (serving/faults.py + serving/resilience.py):
+        # a disarmed injector is a constant-time no-op at every hook,
+        # so a default engine — and every golden — is untouched
+        self.faults = faults if faults is not None else FaultInjector()
+        self.pool.fault_hook = self.faults.on_alloc
+        if self.d_pool is not None:
+            self.d_pool.fault_hook = self.faults.on_alloc
+        if resilience is True:
+            resilience = ResiliencePolicy()
+        self.resilience = resilience
+        self.watchdog = (QuantumWatchdog(resilience)
+                         if resilience is not None else None)
+        if resilience is not None and self.prefix_cache:
+            # arm the chain-hash content verify: publish records a
+            # per-block checksum, attach re-verifies before aliasing
+            self.pool.kv_checksums = True
+            if self.d_pool is not None:
+                self.d_pool.kv_checksums = True
+        self._spec_disabled = False
+        self._plain_quantum = None
+        self._plain_audited = None
+        self._spec_faults = 0
+        self._isolating = False
+        self._quarantined = []   # req_ids finished with reason "error"
+        self._pool_rebuilds = 0
+        self._step_skips = 0
+        self._retries_total = 0
+        self._fault_mark = 0     # injector-journal cursor -> obs/flight
+        self._prefix_quarantine_mark = 0
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, req_id=None, seed=0,
@@ -862,18 +914,38 @@ class ServingEngine:
 
     def step(self):
         """One scheduler iteration: admit, then either a mixed
-        prefill(+decode) step or a jitted decode quantum, then retire."""
+        prefill(+decode) step or a jitted decode quantum, then retire.
+
+        With ``resilience=`` the step is also the FAULT BOUNDARY: pool
+        accounting is audited first (drift rebuilds the allocator from
+        the live block tables instead of killing the engine), and an
+        :class:`~paddle_tpu.serving.faults.InjectedFault` that survives
+        the retry budget is contained here — a poison request is
+        isolated by batch bisect and finished with
+        ``finish_reason="error"``; a transient fault skips the step
+        (nothing was dispatched, so the next step simply retries)."""
         self.stats["steps"] += 1
-        self._admit()
-        live = self.scheduler.live()
-        self.stats["occupancy_sum"] += (
-            len(live) / self.config.num_slots)
-        self.obs.on_step(self._now(), len(live), self.config.num_slots,
-                         self.pool, self.d_pool)
-        if self.scheduler.prefilling():
-            self._mixed_step()
-        elif self.scheduler.decoding():
-            self._decode_quantum()
+        if self.resilience is not None:
+            self._audit_pools()
+        if self.faults.armed:
+            self.faults.maybe_corrupt(self.pool)
+        try:
+            self._admit()
+            live = self.scheduler.live()
+            self.stats["occupancy_sum"] += (
+                len(live) / self.config.num_slots)
+            self.obs.on_step(self._now(), len(live),
+                             self.config.num_slots, self.pool,
+                             self.d_pool)
+            if self.scheduler.prefilling():
+                self._mixed_step()
+            elif self.scheduler.decoding():
+                self._decode_quantum()
+        except InjectedFault as e:
+            self._contain_fault(e)
+        finally:
+            self._sync_faults()
+            self._sync_prefix_quarantines()
         return self.scheduler.has_work
 
     def run(self, requests=None):
@@ -925,6 +997,7 @@ class ServingEngine:
             if self.d_pool is not None:
                 out["draft_prefix_cache"] = \
                     self.d_pool.prefix_cache_stats()
+        out["resilience"] = self.resilience_report()
         return out
 
     def attribution(self):
@@ -954,7 +1027,11 @@ class ServingEngine:
     def decode_step_target(self):
         """(auditable step, example args) for ``analysis.check_budget``
         — the EXACT compiled object the serving hot loop dispatches,
-        with the engine's live state as the example batch."""
+        with the engine's live state as the example batch. A
+        spec-disabled engine hands out the plain fallback quantum (the
+        degraded-mode golden test fingerprints exactly this)."""
+        if self._spec_disabled:
+            return self._plain_audited, self._quantum_args()
         return self._audited, self._quantum_args()
 
     def health(self, now=None):
@@ -968,7 +1045,357 @@ class ServingEngine:
             raise ValueError(
                 "engine built without slo=: pass slo=True (stock "
                 "objectives) or an SLOSet to evaluate health")
-        return self.slo.evaluate(self.obs, now=now)
+        report = self.slo.evaluate(self.obs, now=now)
+        report["resilience"] = self.resilience_report()
+        return report
+
+    # -- resilience: containment, degradation ladders, recovery -----------
+    def resilience_report(self):
+        """Live view of the resilience tier: which degraded modes are
+        active, what was quarantined/rebuilt, and the fault/retry/
+        watchdog counters — carried by ``health()`` and
+        ``engine_stats()``."""
+        out = {
+            "spec_disabled": self._spec_disabled,
+            "spec_faults": self._spec_faults,
+            "quarantined": list(self._quarantined),
+            "pool_rebuilds": self._pool_rebuilds,
+            "prefix_quarantines": self._prefix_quarantine_mark,
+            "step_skips": self._step_skips,
+            "retries_total": self._retries_total,
+            "faults": self.faults.stats(),
+        }
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.stats()
+        return out
+
+    def _audit_pools(self):
+        """Ladder rung 3 — accounting drift: the pool's hard
+        invariants (``_check_accounting``) normally fail-stop; under a
+        resilience policy a drifted pool is REBUILT from the live block
+        tables (the only ground truth tied to real sequence state) and
+        serving continues. The prefix index is conservatively dropped
+        with it — cached subtrees cannot be trusted after drift."""
+        for pool in (self.pool, self.d_pool):
+            if pool is None:
+                continue
+            try:
+                pool._check_accounting()
+            except RuntimeError:
+                pool.rebuild_accounting()
+                self._pool_rebuilds += 1
+                now = self._now()
+                self.obs.on_degrade("pool_rebuild", now)
+                if self.flight is not None:
+                    for r in self.scheduler.live():
+                        self.flight.on_degrade(r, now,
+                                               mode="pool_rebuild")
+
+    def _sync_faults(self):
+        """Fan the injector's journal delta out to obs counters (and
+        the flight journal for poison-attributed entries)."""
+        j = self.faults.journal
+        if self._fault_mark >= len(j):
+            return
+        now = self._now()
+        live = {str(r.req_id): r for r in self.scheduler.live()}
+        for entry in j[self._fault_mark:]:
+            self.obs.on_fault(entry["site"], entry["kind"])
+            if self.flight is not None:
+                req = live.get(str(entry.get("poison", "")))
+                if req is not None:
+                    self.flight.on_fault(req, now, site=entry["site"],
+                                         kind=entry["kind"])
+        self._fault_mark = len(j)
+
+    def _sync_prefix_quarantines(self):
+        """Ladder rung 2 — cached-KV corruption: the pools quarantine
+        a corrupted cached subtree at verify time (paged_cache
+        ``attach_prefix`` under ``kv_checksums``); the engine syncs the
+        counter delta into obs here."""
+        if not self.prefix_cache:
+            return
+        total = int(getattr(self.pool, "prefix_quarantines", 0))
+        if self.d_pool is not None:
+            total += int(getattr(self.d_pool, "prefix_quarantines", 0))
+        if total > self._prefix_quarantine_mark:
+            delta = total - self._prefix_quarantine_mark
+            self._prefix_quarantine_mark = total
+            self.obs.on_quarantine(self._now(), "prefix", count=delta)
+
+    def _contain_fault(self, e):
+        """Containment for an :class:`InjectedFault` that escaped the
+        retry budget (``step()`` is the only caller). A poison fault is
+        isolated — by batch bisect on the decode path, directly on the
+        mixed path where the batch is host-built — and the culprit is
+        finished with ``finish_reason="error"``; everyone else keeps
+        serving. A transient fault (allocation failure, exhausted
+        retries) drops the step on the floor: the injector fires BEFORE
+        any device dispatch, allocation is idempotent, so the next step
+        retries against intact state."""
+        if e.poison is None:
+            self._step_skips += 1
+            return
+        victim = None
+        rows = self.scheduler.decoding()
+        if e.site in ("decode", "spec_round") and len(rows) > 1:
+            victim = self._isolate_poison()
+        if victim is None:
+            victim = next((r for r in self.scheduler.live()
+                           if str(r.req_id) == str(e.poison)), None)
+        if victim is not None:
+            self._quarantine(victim)
+
+    def _isolate_poison(self):
+        """Batch-bisect quarantine: probe subsets of the decoding rows
+        with REAL dispatches — a clean subset makes full progress (its
+        tokens are emitted; the excluded rows ride along done-masked,
+        completely inert through the dispatch) — until one row is
+        isolated. Containment relies only on "a dispatch raises iff
+        its active rows include a poison", never on the exception
+        naming the culprit. Returns the isolated Request, or None if
+        every probe ran clean."""
+        suspects = list(self.scheduler.decoding())
+        self._isolating = True
+        try:
+            while len(suspects) > 1:
+                half = suspects[:len(suspects) // 2]
+                rest = suspects[len(suspects) // 2:]
+                if self._probe(half):
+                    suspects = half
+                else:
+                    # half is clean and just made progress (some of it
+                    # may even have finished) — the poison is in rest
+                    suspects = [r for r in rest if not r.finished]
+            if len(suspects) == 1 and self._probe(suspects):
+                return suspects[0]
+            return None
+        finally:
+            self._isolating = False
+
+    def _probe(self, subset):
+        """One real dispatch restricted to ``subset``; True if an
+        injected fault fired (no progress), False after a clean
+        dispatch whose tokens were emitted."""
+        try:
+            self._decode_quantum(include=subset)
+        except InjectedFault:
+            return True
+        return False
+
+    def _quarantine(self, req):
+        """Finish one poison request with ``finish_reason="error"``
+        and keep serving everyone else: its blocks return to every
+        pool through the normal retire path, obs records the bad
+        outcome (the error rate burns the SLO error budget), and the
+        injector is cured so probes stop raising."""
+        now = self._now()
+        req.finished = True
+        req.finish_reason = "error"
+        if req.finish_time is None:
+            req.finish_time = now
+        self.faults.cure(req.req_id)
+        self._quarantined.append(str(req.req_id))
+        self.obs.on_quarantine(now, "poison")
+        if self.flight is not None:
+            self.flight.on_fault(req, now, site="quarantine",
+                                 kind="poison")
+        if req.slot is not None:
+            self._retire_finished()
+
+    def _note_spec_fault(self):
+        """Ladder rung 1 — repeated spec-round faults (injected raises
+        or watchdog trips) one-way degrade to the plain quantum."""
+        if self.spec_draft is None or self._spec_disabled:
+            return
+        self._spec_faults += 1
+        if (self.resilience is not None
+                and self._spec_faults
+                >= self.resilience.spec_fault_threshold):
+            self._disable_spec()
+
+    def _disable_spec(self):
+        """Fall back from the speculative round to the PLAIN decode
+        quantum — the same compiled family a ``spec_draft=None`` build
+        jits, so no new golden. In-flight state carries over unchanged:
+        the target pool holds every accepted token's KV, greedy streams
+        continue bit-exact (the spec greedy arm already emits the
+        target's own argmax stream), and the draft pool simply stops
+        growing (its blocks free on retire/preempt as usual — ``free``
+        is a no-op for sequences that never ensured draft blocks)."""
+        if self._spec_disabled or self.spec_draft is None:
+            return
+        self._spec_disabled = True
+        cfg = self.model.config
+        self._plain_quantum = jax.jit(self._make_quantum(),
+                                      donate_argnums=(0, 1))
+        self._plain_audited = _AuditedStep(
+            self._plain_quantum, n_donatable=2 * cfg.num_hidden_layers,
+            mesh=self.mesh)
+        now = self._now()
+        self.obs.on_degrade("spec_disabled", now)
+        if self.flight is not None:
+            for r in self.scheduler.live():
+                self.flight.on_degrade(r, now, mode="spec_disabled")
+
+    def _guarded_dispatch(self, kind, rows):
+        """One quantum dispatch under the resilience envelope: the
+        injector's pre-dispatch check (faults fire BEFORE any donated
+        buffer is consumed, so a retry re-runs against intact state),
+        exponential-backoff retries for transient injected faults, and
+        the wall-clock watchdog. Real exceptions propagate untouched —
+        fail-stop is preserved for anything the injector didn't
+        cause. Isolation probes never retry (the raise IS the probe
+        signal), and poison faults escalate immediately."""
+        rids = [r.req_id for r in rows]
+        pol = self.resilience
+        attempt = 0
+        while True:
+            t0 = self._now()
+            try:
+                self.faults.before_dispatch(kind, rids)
+                out = self._dispatch_quantum()
+            except InjectedFault as e:
+                if kind == "spec_round" and e.poison is None:
+                    self._note_spec_fault()
+                    if self._spec_disabled:
+                        # the fault just crossed the disable threshold:
+                        # a retry here would dispatch the PLAIN quantum
+                        # under the spec-round caller — skip the step
+                        # instead; the next step takes the plain path
+                        raise
+                if (self._isolating or e.poison is not None
+                        or pol is None or attempt >= pol.max_retries):
+                    raise
+                delay = pol.backoff_s(attempt)
+                attempt += 1
+                self._retries_total += 1
+                self.obs.on_retry(kind, attempt)
+                if self.flight is not None:
+                    now = self._now()
+                    for r in rows:
+                        self.flight.on_retry(r, now, kind=kind,
+                                             attempt=attempt,
+                                             backoff_s=delay)
+                pol.sleep(delay)
+                continue
+            if self.watchdog is not None:
+                dt = self._now() - t0
+                if self.watchdog.check(kind, dt):
+                    self.obs.on_watchdog(kind, dt)
+                    if kind == "spec_round":
+                        self._note_spec_fault()
+            return out
+
+    # -- crash recovery: snapshot / restore --------------------------------
+    def snapshot(self):
+        """JSON-able crash-recovery image of the SCHEDULER tier: every
+        in-flight request's identity, generation params, and
+        emitted-so-far tokens (plus completed-request summaries for
+        audit). Device state is deliberately NOT captured — a restored
+        engine re-admits each in-flight request through the existing
+        RECOMPUTE-ON-RESUME machinery (``Request.begin_resume``:
+        re-prefill ``prompt + tokens``, continue via
+        ``fold_in(key, n_emitted)``), so greedy output streams are
+        bit-exact vs the uninterrupted run without serializing a single
+        pool buffer."""
+        def req_state(req):
+            return {
+                "req_id": str(req.req_id),
+                "prompt": [int(t) for t in np.asarray(req.prompt)],
+                "max_new_tokens": int(req.max_new_tokens),
+                "seed": int(req.seed),
+                "priority": int(req.priority),
+                "temperature": (None if req.temperature is None
+                                else float(req.temperature)),
+                "stop_token_ids": (sorted(req.stop_token_ids)
+                                   if req.stop_token_ids else None),
+                "stop_sequences": ([list(s) for s in req.stop_sequences]
+                                   if req.stop_sequences else None),
+                "tokens": [int(t) for t in req.tokens],
+                "preemptions": int(req.preemptions),
+            }
+
+        inflight = list(self.scheduler.live()) + list(
+            self.scheduler.waiting)
+        return {
+            "version": 1,
+            "kind": "serving_engine_snapshot",
+            "num_slots": self.config.num_slots,
+            "block_size": self.pool.block_size,
+            "max_context": self.max_context,
+            "prefill_chunk": self.config.prefill_chunk,
+            "decode_quantum": self.config.decode_quantum,
+            "decode_strategy": self.decode_strategy,
+            "top_k": self.top_k, "top_p": self.top_p,
+            "temperature": self.temperature,
+            "eos_token_id": self.eos_token_id,
+            "spec_gamma": self.spec_gamma,
+            "prefix_cache": self.prefix_cache,
+            "per_request_sampling": self._per_request_sampling,
+            "submitted_total": self.scheduler._submitted_total,
+            "inflight": [req_state(r) for r in inflight],
+            "completed": [{"req_id": str(r.req_id),
+                           "tokens": [int(t) for t in r.tokens],
+                           "finish_reason": r.finish_reason}
+                          for r in self.completed],
+        }
+
+    @classmethod
+    def restore(cls, snap, model, spec_draft=None, **overrides):
+        """Build a FRESH engine from a :meth:`snapshot` and re-admit
+        every in-flight request via recompute-on-resume. ``model`` (and
+        ``spec_draft``) are re-supplied by the caller — params are not
+        part of the snapshot; ``overrides`` adjust any constructor
+        kwarg (e.g. ``resilience=True``, ``flight=True``). Completed
+        summaries ride the snapshot for audit but are not
+        re-materialized."""
+        if snap.get("kind") != "serving_engine_snapshot":
+            raise ValueError(
+                "not a serving engine snapshot (kind="
+                f"{snap.get('kind')!r})")
+        kwargs = dict(
+            num_slots=snap["num_slots"], block_size=snap["block_size"],
+            max_context=snap["max_context"],
+            prefill_chunk=snap["prefill_chunk"],
+            decode_quantum=snap["decode_quantum"],
+            decode_strategy=snap["decode_strategy"],
+            top_k=snap["top_k"], top_p=snap["top_p"],
+            temperature=snap["temperature"],
+            eos_token_id=snap["eos_token_id"],
+            spec_gamma=snap["spec_gamma"],
+            prefix_cache=snap["prefix_cache"],
+            per_request_sampling=snap["per_request_sampling"])
+        kwargs.update(overrides)
+        eng = cls(model, spec_draft=spec_draft, **kwargs)
+        now = eng._now()
+        for st in snap["inflight"]:
+            req = Request(
+                np.asarray(st["prompt"], np.int32),
+                max_new_tokens=st["max_new_tokens"],
+                req_id=st["req_id"], seed=st["seed"],
+                priority=st["priority"],
+                temperature=st["temperature"],
+                stop_token_ids=st["stop_token_ids"],
+                stop_sequences=st["stop_sequences"],
+                arrival_time=now)
+            req.tokens = list(st["tokens"])
+            req.preemptions = int(st["preemptions"])
+            if req.tokens or req.preemptions:
+                # the restart IS a whole-engine preemption: re-admission
+                # re-prefills prompt + tokens; the recomputed tokens are
+                # NOT re-emitted and the continuation stays bit-exact
+                req.begin_resume()
+            eng.scheduler.submit(req)
+            eng._on_submitted(req)
+            if eng.flight is not None:
+                eng.flight.on_restore(req, now,
+                                      tokens_resumed=len(req.tokens))
+        eng.scheduler._submitted_total = max(
+            eng.scheduler._submitted_total,
+            int(snap.get("submitted_total", 0)))
+        eng.obs.on_restore(now, len(snap["inflight"]))
+        return eng
 
     # -- admission + prefill ----------------------------------------------
     def _admit(self):
@@ -1094,7 +1521,10 @@ class ServingEngine:
         pre = self.scheduler.prefilling()
         dec = self.scheduler.decoding()
         rows = pre + dec
-        spec = self.spec_draft is not None
+        spec = self.spec_draft is not None and not self._spec_disabled
+        # the mixed step's fault boundary: BEFORE any pool mutation, so
+        # a raised step retries cleanly from the next step()
+        self.faults.before_dispatch("mixed", [r.req_id for r in rows])
         toks, this_time, enc_lens, dec_lens = [], [], [], []
         # cost-ledger work split: a resumed row's chunk re-computes KV
         # a preemption dropped (recompute debt); a fresh row's chunk is
@@ -1220,6 +1650,9 @@ class ServingEngine:
                        "novel_tokens": novel_toks,
                        "recompute_tokens": recompute_toks,
                        "decode_rows": len(dec)})
+        if self.watchdog is not None and self.watchdog.check(
+                "mixed", now - t0):
+            self.obs.on_watchdog("mixed", now - t0)
         self._retire_finished()
 
     def _emit(self, req, tok):
@@ -1349,7 +1782,7 @@ class ServingEngine:
         return jax.device_put(v, self._rep_sharding)
 
     def _quantum_args(self):
-        if self.spec_draft is not None:
+        if self.spec_draft is not None and not self._spec_disabled:
             return (list(self.pool.k_pools), list(self.pool.v_pools),
                     list(self.d_pool.k_pools),
                     list(self.d_pool.v_pools),
@@ -1377,42 +1810,64 @@ class ServingEngine:
         (the first call's trace needs the mesh installed for the mp
         layers' constraints) and through the build-time compiled
         executable when present — the census compile doubles as the
-        serving executable."""
+        serving executable. After a spec-disable degrade the PLAIN
+        fallback quantum dispatches instead (the tp census executable
+        was compiled for the spec signature)."""
+        quantum = (self._plain_quantum if self._spec_disabled
+                   else self._quantum)
         if self.mesh is None:
-            return self._quantum(*self._quantum_args())
+            return quantum(*self._quantum_args())
         with MeshScope(self.mesh):
-            if self._quantum_compiled is not None:
+            if (self._quantum_compiled is not None
+                    and not self._spec_disabled):
                 return self._quantum_compiled(*self._quantum_args())
-            return self._quantum(*self._quantum_args())
+            return quantum(*self._quantum_args())
 
-    def _spec_round_step(self):
+    def _spec_round_step(self, include=None):
         """Dispatch ONE jitted speculative round (draft-γ scan + target
         verify + in-graph acceptance and cache roll forward/back); the
         host runs only here, at the admit/retire boundary — variable
         per-round token yield composes with the same retirement masks
-        as the plain quantum."""
+        as the plain quantum. ``include`` restricts the round to a
+        subset of the decoding rows (the bisect-quarantine probe path):
+        excluded rows ride along done-masked — inert through the
+        dispatch — and their host state is restored afterwards."""
         g = self.spec_gamma
         t0 = self._now()
         self.stats["spec_rounds"] += 1
         rows = self.scheduler.decoding()
-        for req in rows:
-            slot = req.slot
-            # cover the round's worst-case writes (γ proposals past the
-            # accepted history) in BOTH pools before entering the
-            # device loop — tables are static inside
-            need = int(self._seq_lens[slot]) + g + 1
-            for pool, tables in ((self.pool, self._tables),
-                                 (self.d_pool, self._d_tables)):
-                if need > pool.seq_len(req.req_id):
-                    pool.ensure(req.req_id, need)
-                if self.prefix_cache:
-                    pool.make_writable(req.req_id,
-                                       int(self._seq_lens[slot]), need)
-                row = pool.block_table_array(
-                    [req.req_id], pad_to=self._table_width)
-                tables[slot] = np.asarray(row)[0][:self._table_width]
-        (t_kc, t_vc, d_kc, d_vc, seq_lens, last_tok, n_gen, done,
-         stream, counts, acc) = self._dispatch_quantum()
+        excluded = []
+        if include is not None:
+            keep = {id(r) for r in include}
+            excluded = [r for r in rows if id(r) not in keep]
+            rows = [r for r in rows if id(r) in keep]
+            for r in excluded:
+                self._done[r.slot] = True
+        try:
+            for req in rows:
+                slot = req.slot
+                # cover the round's worst-case writes (γ proposals past
+                # the accepted history) in BOTH pools before entering
+                # the device loop — tables are static inside
+                need = int(self._seq_lens[slot]) + g + 1
+                for pool, tables in ((self.pool, self._tables),
+                                     (self.d_pool, self._d_tables)):
+                    if need > pool.seq_len(req.req_id):
+                        pool.ensure(req.req_id, need)
+                    if self.prefix_cache:
+                        pool.make_writable(
+                            req.req_id, int(self._seq_lens[slot]), need)
+                    row = pool.block_table_array(
+                        [req.req_id], pad_to=self._table_width)
+                    tables[slot] = np.asarray(row)[0][
+                        :self._table_width]
+            (t_kc, t_vc, d_kc, d_vc, seq_lens, last_tok, n_gen, done,
+             stream, counts, acc) = self._guarded_dispatch(
+                 "spec_round", rows)
+        except BaseException:
+            for r in excluded:
+                self._done[r.slot] = r.finished
+            raise
         self.pool.k_pools = list(t_kc)
         self.pool.v_pools = list(t_vc)
         self.d_pool.k_pools = list(d_kc)
@@ -1424,6 +1879,10 @@ class ServingEngine:
         self._last_tok = np.asarray(last_tok).copy()
         self._n_gen = np.asarray(n_gen).copy()
         self._done = np.asarray(done).copy()
+        for r in excluded:
+            # a masked row's device state carried through unchanged;
+            # only its done flag was forced — restore the host truth
+            self._done[r.slot] = r.finished
         self.stats["quantum_tokens"] += int(counts.sum())
         self.stats["spec_proposed"] += g * len(rows)
         self.stats["spec_accepted"] += int(acc.sum())
@@ -1448,31 +1907,49 @@ class ServingEngine:
         self.obs.on_spec_round(now, g * len(rows), int(acc.sum()))
         self._retire_finished()
 
-    def _decode_quantum(self):
+    def _decode_quantum(self, include=None):
         """Dispatch one jitted quantum; the single host sync per
         ``decode_quantum`` tokens happens HERE, at the admit/retire
-        boundary, never inside the compiled loop."""
-        if self.spec_draft is not None:
-            return self._spec_round_step()
+        boundary, never inside the compiled loop. ``include`` restricts
+        the quantum to a subset of the decoding rows (the
+        bisect-quarantine probe path): excluded rows ride along
+        done-masked — inert through the dispatch — and their host
+        state is restored afterwards."""
+        if self.spec_draft is not None and not self._spec_disabled:
+            return self._spec_round_step(include=include)
         t0 = self._now()
         self.stats["decode_quanta"] += 1
         t_steps = self.config.decode_quantum
-        # grow each live slot's block table to cover the quantum before
-        # entering the device loop (tables are static inside)
-        for req in self.scheduler.decoding():
-            slot = req.slot
-            cap = req.prompt_len + req.max_new_tokens - 1
-            need = min(int(self._seq_lens[slot]) + t_steps, cap)
-            if need > self.pool.seq_len(req.req_id):
-                self.pool.ensure(req.req_id, need)
-            if self.prefix_cache:
-                self.pool.make_writable(req.req_id,
-                                        int(self._seq_lens[slot]), need)
-            row = self.pool.block_table_array(
-                [req.req_id], pad_to=self._table_width)
-            self._tables[slot] = np.asarray(row)[0][:self._table_width]
-        kc, vc, seq_lens, last_tok, n_gen, done, toks = \
-            self._dispatch_quantum()
+        rows = self.scheduler.decoding()
+        excluded = []
+        if include is not None:
+            keep = {id(r) for r in include}
+            excluded = [r for r in rows if id(r) not in keep]
+            rows = [r for r in rows if id(r) in keep]
+            for r in excluded:
+                self._done[r.slot] = True
+        try:
+            # grow each live slot's block table to cover the quantum
+            # before entering the device loop (tables static inside)
+            for req in rows:
+                slot = req.slot
+                cap = req.prompt_len + req.max_new_tokens - 1
+                need = min(int(self._seq_lens[slot]) + t_steps, cap)
+                if need > self.pool.seq_len(req.req_id):
+                    self.pool.ensure(req.req_id, need)
+                if self.prefix_cache:
+                    self.pool.make_writable(
+                        req.req_id, int(self._seq_lens[slot]), need)
+                row = self.pool.block_table_array(
+                    [req.req_id], pad_to=self._table_width)
+                self._tables[slot] = np.asarray(row)[0][
+                    :self._table_width]
+            kc, vc, seq_lens, last_tok, n_gen, done, toks = \
+                self._guarded_dispatch("decode", rows)
+        except BaseException:
+            for r in excluded:
+                self._done[r.slot] = r.finished
+            raise
         self.pool.k_pools = list(kc)
         self.pool.v_pools = list(vc)
         toks = np.asarray(toks)                          # (T, S) sync
@@ -1480,11 +1957,14 @@ class ServingEngine:
         self._last_tok = np.asarray(last_tok).copy()
         self._n_gen = np.asarray(n_gen).copy()
         self._done = np.asarray(done).copy()
+        for r in excluded:
+            # a masked row's device state carried through unchanged;
+            # only its done flag was forced — restore the host truth
+            self._done[r.slot] = r.finished
         self.stats["quantum_tokens"] += int(toks.shape[0]) * int(
             toks.shape[1])
         now = self._now()
         emitted = 0
-        rows = self.scheduler.decoding()
         for req in rows:
             slot = req.slot
             got = 0

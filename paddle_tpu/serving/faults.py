@@ -1,0 +1,256 @@
+"""Deterministic, seeded fault injection for the serving engine
+(reference: the restart/fault semantics the Fleet elastic-launch tier
+assumes — PAPER.md north-star — and ROADMAP item 5's adversarial soak:
+"window x spec x preempt x COW interleavings as a randomized soak that
+replays any failure from its seed + flight journals").
+
+The injector threads through the engine's EXISTING host boundaries —
+quantum dispatch (``before_dispatch``), pool ``_alloc_block``
+(``on_alloc`` via ``pool.fault_hook``), and the per-step KV corruption
+sweep (``maybe_corrupt``) — and never touches the compiled graphs:
+every injected fault fires on the host BEFORE the device dispatch it
+targets, so a retried quantum re-runs against un-donated, un-mutated
+buffers and the ``max_host_callbacks=0`` budgets of every serving
+recipe are untouched. A default-constructed injector (empty plan) is
+**disarmed**: every hook is a constant-time no-op and all compiled
+goldens stay byte-identical (the analysis recipes build their engines
+with a disarmed injector to pin exactly that).
+
+Determinism contract: same ``seed`` + same ``plan`` + same call
+sequence -> the same faults fire at the same call indices and the
+``journal`` lists are identical. The chaos soak replays any failure
+from its seed plus the engine's flight journal.
+"""
+from __future__ import annotations
+
+import random
+import time
+
+import numpy as np
+
+__all__ = ["InjectedFault", "FaultSpec", "FaultInjector",
+           "FAULT_SITES", "FAULT_KINDS"]
+
+#: host boundaries the injector can target: the three quantum kinds
+#: (matching ``obs.on_quantum``'s kind labels), the pool allocator,
+#: cached-KV corruption, and the prefix-verify walk.
+FAULT_SITES = ("decode", "spec_round", "mixed", "alloc", "kv", "prefix")
+
+#: what fires at a matched site: ``raise`` (an :class:`InjectedFault`
+#: before dispatch), ``slow`` (sleep ``sleep_s`` — watchdog fodder),
+#: ``alloc_fail`` (the pool raises as if exhausted), ``bit_flip``
+#: (corrupt one element of a cached-only KV block), ``poison`` (mark a
+#: live request so every dispatch containing it raises — the batch
+#: bisect isolates it).
+FAULT_KINDS = ("raise", "slow", "alloc_fail", "bit_flip", "poison")
+
+
+class InjectedFault(RuntimeError):
+    """A fault the injector raised on purpose. The engine retries ONLY
+    this type (real exceptions keep fail-stop semantics); ``site`` /
+    ``kind`` say where it fired, ``poison`` carries the poisoned
+    req_id when the fault is a poison trip."""
+
+    def __init__(self, site, kind, detail=None, poison=None):
+        self.site = site
+        self.kind = kind
+        self.poison = poison
+        msg = f"injected {kind} at {site}"
+        if poison is not None:
+            msg += f" (poison {poison})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class FaultSpec:
+    """One declarative fault: fire ``kind`` at ``site`` with
+    probability ``p`` per eligible call, at most ``times`` times
+    (None = unbounded). ``sleep_s`` sizes a ``slow`` fault's stall;
+    ``detail`` rides into the raised message."""
+
+    def __init__(self, site, kind, p=1.0, times=None, sleep_s=0.05,
+                 detail=None):
+        if site not in FAULT_SITES:
+            raise ValueError(f"unknown fault site {site!r}; "
+                             f"expected one of {FAULT_SITES}")
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; "
+                             f"expected one of {FAULT_KINDS}")
+        self.site = site
+        self.kind = kind
+        self.p = float(p)
+        self.times = None if times is None else int(times)
+        self.sleep_s = float(sleep_s)
+        self.detail = detail
+        self.fired = 0
+
+    def exhausted(self):
+        return self.times is not None and self.fired >= self.times
+
+    def __repr__(self):
+        return (f"FaultSpec({self.site!r}, {self.kind!r}, p={self.p}, "
+                f"times={self.times}, fired={self.fired})")
+
+
+class FaultInjector:
+    """Seeded declarative fault injection at the engine's host
+    boundaries.
+
+    Args:
+        plan: iterable of :class:`FaultSpec` (or ``(site, kind)`` /
+            ``(site, kind, p)`` tuples). Empty -> disarmed no-op.
+        seed: seeds the private ``random.Random`` that draws every
+            per-call fire/skip decision — same seed + plan + call
+            sequence replays the same faults.
+        sleep: injectable stall fn for ``slow`` faults (tests pass a
+            stub; default ``time.sleep``).
+    """
+
+    def __init__(self, plan=(), seed=0, sleep=time.sleep):
+        self.plan = [s if isinstance(s, FaultSpec) else FaultSpec(*s)
+                     for s in plan]
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._sleep = sleep
+        self.injected_total = 0
+        self.journal = []          # replayable record of every fire
+        self._poisoned = set()     # req_ids whose dispatches raise
+        self._calls = 0            # monotone call index (journal key)
+
+    # -- arming state ------------------------------------------------------
+    @property
+    def armed(self):
+        """True while any spec can still fire or a poison is pending —
+        a disarmed injector's hooks are constant-time no-ops."""
+        return (bool(self._poisoned)
+                or any(not s.exhausted() for s in self.plan))
+
+    def poison(self, req_id):
+        """Mark ``req_id`` as poison: every dispatch whose active rows
+        include it raises until the engine's bisect quarantine finishes
+        it with ``finish_reason="error"`` and calls :meth:`cure`."""
+        self._poisoned.add(str(req_id))
+
+    def cure(self, req_id):
+        self._poisoned.discard(str(req_id))
+
+    @property
+    def poisoned(self):
+        return frozenset(self._poisoned)
+
+    # -- plan matching -----------------------------------------------------
+    def _fire(self, spec, site, **extra):
+        spec.fired += 1
+        self.injected_total += 1
+        self.journal.append({"call": self._calls, "site": site,
+                             "kind": spec.kind, **extra})
+
+    def _match(self, site, kinds):
+        """First live spec for ``site`` with a kind in ``kinds`` whose
+        coin flip lands — the rng is consulted for every candidate so
+        the decision sequence is a pure function of seed + plan +
+        call order."""
+        for spec in self.plan:
+            if spec.site != site or spec.kind not in kinds:
+                continue
+            if spec.exhausted():
+                continue
+            if self._rng.random() < spec.p:
+                return spec
+        return None
+
+    # -- engine hooks ------------------------------------------------------
+    def before_dispatch(self, site, active_req_ids=()):
+        """Called by the engine immediately BEFORE a quantum dispatch
+        (site in decode | spec_round | mixed) with the req_ids of the
+        rows about to run. Raises :class:`InjectedFault` for a matched
+        ``raise`` spec or a poisoned active row; stalls for a matched
+        ``slow`` spec. Firing before dispatch keeps retries
+        side-effect-free (no donated buffer has been consumed)."""
+        if not (self.plan or self._poisoned):
+            return
+        self._calls += 1
+        for rid in active_req_ids:
+            if str(rid) in self._poisoned:
+                spec = self._match(site, ("poison",))
+                if spec is not None:
+                    self._fire(spec, site, poison=str(rid))
+                else:
+                    self.injected_total += 1
+                    self.journal.append(
+                        {"call": self._calls, "site": site,
+                         "kind": "poison", "poison": str(rid)})
+                raise InjectedFault(site, "poison", poison=str(rid))
+        spec = self._match(site, ("raise", "slow"))
+        if spec is None:
+            return
+        if spec.kind == "slow":
+            self._fire(spec, site, sleep_s=spec.sleep_s)
+            self._sleep(spec.sleep_s)
+            return
+        self._fire(spec, site)
+        raise InjectedFault(site, "raise", detail=spec.detail)
+
+    def on_alloc(self, pool):
+        """Bound to ``pool.fault_hook``: called inside
+        ``_alloc_block`` before a block leaves the free list. A matched
+        ``alloc_fail`` raises :class:`InjectedFault` — the pool's
+        state is untouched (nothing was popped yet), so the engine can
+        simply retry the step."""
+        if not self.plan:
+            return
+        self._calls += 1
+        spec = self._match("alloc", ("alloc_fail",))
+        if spec is None:
+            return
+        self._fire(spec, "alloc")
+        raise InjectedFault("alloc", "alloc_fail", detail=spec.detail)
+
+    def maybe_corrupt(self, pool):
+        """Called once per engine step: a matched ``kv``/``bit_flip``
+        spec flips one bit of one element in a CACHED-ONLY block
+        (refcount==1 and held solely by the prefix index) of layer 0's
+        K pool — corruption that the chain-hash verify at the next
+        ``attach_prefix`` must catch, without ever corrupting a live
+        request's stream. No eligible block -> records a skip and does
+        nothing. Returns the corrupted block id or None."""
+        if not self.plan:
+            return None
+        self._calls += 1
+        spec = self._match("kv", ("bit_flip",))
+        if spec is None:
+            return None
+        held = set()
+        for blocks in pool._tables.values():
+            held.update(blocks)
+        victims = sorted(b for b, e in pool._cached_blocks.items()
+                         if pool._refcounts.get(b) == 1
+                         and b not in held)
+        if not victims:
+            self.journal.append({"call": self._calls, "site": "kv",
+                                 "kind": "bit_flip", "skipped": True})
+            return None
+        blk = victims[self._rng.randrange(len(victims))]
+        kp = np.asarray(pool.k_pools[0]).copy()
+        flat = kp.reshape(kp.shape[0], -1)
+        j = self._rng.randrange(flat.shape[1])
+        raw = flat[blk].view(np.uint16 if flat.dtype.itemsize == 2
+                             else np.uint32)
+        bit = self._rng.randrange(raw.dtype.itemsize * 8)
+        raw[j] = raw[j] ^ np.asarray(1 << bit, raw.dtype)
+        pool.k_pools[0] = pool._pin(kp)
+        self._fire(spec, "kv", block=int(blk), elem=int(j),
+                   bit=int(bit))
+        return int(blk)
+
+    # -- views -------------------------------------------------------------
+    def stats(self):
+        return {
+            "seed": self.seed,
+            "armed": self.armed,
+            "injected_total": self.injected_total,
+            "poisoned": sorted(self._poisoned),
+            "plan": [repr(s) for s in self.plan],
+            "journal_len": len(self.journal),
+        }

@@ -37,6 +37,13 @@ per-request-sampling quantum variant with its own golden):
 - **graceful drain**: :meth:`drain` stops NEW admissions (submissions
   shed with reason ``draining``), finishes everything already
   accepted, and flushes the flight recorder.
+- **failure semantics + crash recovery**: streams never hang — an
+  engine-side failure or an engine gone idle closes every open stream
+  terminally with ``finish_reason == "error"`` and ``timeout=`` bounds
+  each token wait; :meth:`ServingFrontDoor.snapshot` /
+  :meth:`ServingFrontDoor.restore` rebuild the whole front door from a
+  JSON-able engine snapshot with in-flight streams re-opened and
+  pre-loaded (recompute-on-resume; serving/engine.py).
 - **prefix-cache visibility**: on a ``prefix_cache=True`` engine,
   ``TokenStream.cached_prefix_tokens`` reports how many prompt tokens
   this request aliased from the content-addressed prefix index
@@ -68,13 +75,21 @@ class TokenStream:
     ``stream.result()`` drives to completion and returns the generated
     ids as one int32 array; ``stream.request`` is the live
     :class:`~paddle_tpu.serving.scheduler.Request` (``finish_reason``:
-    ``eos`` | ``stop`` | ``length`` | ``shed``)."""
+    ``eos`` | ``stop`` | ``length`` | ``shed`` | ``error``).
 
-    def __init__(self, request, frontdoor):
+    Failure semantics (the hang fix): an engine-side exception during a
+    pump, or the engine going idle with this stream still open, closes
+    the stream terminally with ``finish_reason == "error"`` instead of
+    blocking the consumer forever; ``timeout`` seconds without a new
+    token raises ``TimeoutError`` (sync) / ``asyncio.TimeoutError``
+    (async) without touching the request's engine state."""
+
+    def __init__(self, request, frontdoor, timeout=None):
         self.request = request
         self._fd = frontdoor
         self._buf = deque()
         self._closed = False
+        self._timeout = None if timeout is None else float(timeout)
         self._aevent = None  # lazy: only async consumers pay for it
 
     # -- producer side (the front door's token sink) ----------------------
@@ -85,6 +100,21 @@ class TokenStream:
     def _close(self):
         self._closed = True
         self._wake()
+
+    def _error_close(self, detail):
+        """Terminal error close: the request is finished with
+        ``finish_reason="error"`` (only if nothing finished it first)
+        and the stream closes — the consumer's loop ends instead of
+        hanging. Only called when the request is OUT of the engine
+        (engine idle / engine dead), so the mutation cannot race a
+        live slot."""
+        req = self.request
+        if not req.finished:
+            req.finished = True
+            req.finish_reason = "error"
+            req.finish_time = self._fd.engine.obs.now()
+        self._fd._streams.pop(str(req.req_id), None)
+        self._close()
 
     def _wake(self):
         if self._aevent is not None:
@@ -113,12 +143,33 @@ class TokenStream:
         return self.request.cached_prefix_tokens
 
     def __iter__(self):
+        eng = self._fd.engine
+        last = eng.obs.now()
         while True:
             while self._buf:
+                last = eng.obs.now()
                 yield self._buf.popleft()
             if self._closed:
                 return
-            self._fd.pump()
+            if not eng.has_work:
+                # the engine went idle while this stream is still open:
+                # the request fell out of the scheduler (engine died or
+                # dropped it) — pumping again would spin forever
+                self._error_close("engine idle with stream open")
+                return
+            if (self._timeout is not None
+                    and eng.obs.now() - last > self._timeout):
+                raise TimeoutError(
+                    f"no token for request {self.request.req_id!r} in "
+                    f"{self._timeout}s")
+            try:
+                self._fd.pump()
+            except Exception:
+                # engine-side failure: every open stream (this one
+                # included) closes with finish_reason="error"; the
+                # pumping caller also sees the exception
+                self._fd._fail_open_streams()
+                raise
 
     def __aiter__(self):
         return self
@@ -131,9 +182,18 @@ class TokenStream:
                 return self._buf.popleft()
             if self._closed:
                 raise StopAsyncIteration
+            if self.request.finished:
+                # finished without a closing push (e.g. quarantined
+                # with finish_reason="error") — terminal, not a hang
+                self._close()
+                raise StopAsyncIteration
             if self._aevent is None:
                 self._aevent = asyncio.Event()
-            await self._aevent.wait()
+            if self._timeout is None:
+                await self._aevent.wait()
+            else:
+                await asyncio.wait_for(self._aevent.wait(),
+                                       self._timeout)
             self._aevent.clear()
 
     def result(self):
@@ -178,10 +238,12 @@ class ServingFrontDoor:
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, priority=NORMAL,
                temperature=None, stop_token_ids=None,
-               stop_sequences=None, seed=0, req_id=None):
+               stop_sequences=None, seed=0, req_id=None, timeout=None):
         """Admit-or-shed one request; always returns a
         :class:`TokenStream` (a shed request's stream is already closed
-        with ``finish_reason == "shed"`` — check ``stream.shed``)."""
+        with ``finish_reason == "shed"`` — check ``stream.shed``).
+        ``timeout`` bounds the stream's wait for each next token
+        (None = wait forever; see :class:`TokenStream`)."""
         eng = self.engine
         now = eng.obs.now()
         if self._draining:
@@ -199,7 +261,7 @@ class ServingFrontDoor:
                          stop_token_ids=stop_token_ids,
                          stop_sequences=stop_sequences,
                          arrival_time=now)
-        stream = TokenStream(req, self)
+        stream = TokenStream(req, self, timeout=timeout)
         self._streams[str(req.req_id)] = stream
         return stream
 
@@ -273,12 +335,33 @@ class ServingFrontDoor:
             n += 1
         return n
 
+    def _reap_finished(self):
+        """Close streams whose request finished WITHOUT a final token
+        push: a quarantined (``finish_reason="error"``) request emits
+        nothing, so ``_on_token`` never fires for it — without this
+        sweep its consumer would pump forever."""
+        for rid, stream in list(self._streams.items()):
+            if stream.request.finished:
+                stream._close()
+                self._streams.pop(rid, None)
+
+    def _fail_open_streams(self):
+        """The engine raised out of a pump: every open stream closes
+        terminally with ``finish_reason="error"`` so no consumer —
+        including ones on other streams — blocks on a dead engine."""
+        for stream in list(self._streams.values()):
+            stream._error_close("engine failed")
+        self._streams.clear()
+
     def pump(self):
         """One front-door iteration: preemption policy, then one engine
         scheduler step (admit -> mixed prefill | decode quantum ->
-        retire). Returns True while work remains."""
+        retire), then the finished-stream reap. Returns True while work
+        remains."""
         self._apply_preemption()
-        return self.engine.step()
+        alive = self.engine.step()
+        self._reap_finished()
+        return alive
 
     def run_until_idle(self):
         """Drive synchronously until no work remains; returns the
@@ -337,6 +420,34 @@ class ServingFrontDoor:
             if flight_path is not None:
                 out["flight_path"] = eng.flight.save(flight_path)
         return out
+
+    # -- crash recovery ----------------------------------------------------
+    def snapshot(self):
+        """The engine's crash-recovery snapshot (JSON-able; see
+        :meth:`ServingEngine.snapshot`) — the front door adds nothing:
+        its streams are reconstructed by :meth:`restore`."""
+        return self.engine.snapshot()
+
+    @classmethod
+    def restore(cls, snap, model, policy=None, spec_draft=None,
+                **overrides):
+        """Rebuild a front door (and its engine) from a snapshot: every
+        in-flight request is re-admitted via recompute-on-resume and
+        gets a FRESH open :class:`TokenStream` pre-loaded with its
+        already-emitted tokens — a consumer iterating the restored
+        stream sees the full sequence, and the continuation is
+        bit-exact for greedy requests."""
+        from .engine import ServingEngine
+
+        eng = ServingEngine.restore(snap, model, spec_draft=spec_draft,
+                                    **overrides)
+        fd = cls(eng, policy=policy)
+        for req in list(eng.scheduler.waiting):
+            stream = TokenStream(req, fd)
+            for tok in req.tokens:
+                stream._buf.append(int(tok))
+            fd._streams[str(req.req_id)] = stream
+        return fd
 
     # -- views -------------------------------------------------------------
     @property

@@ -1,0 +1,118 @@
+"""Watchdog + retry/quarantine policy for the serving engine
+(reference: the restart semantics of ``paddle.distributed.launch`` /
+Fleet elastic launch — PAPER.md north-star — brought down to the
+single-replica tier: before a cluster can fail over between replicas,
+one replica must survive, degrade, and recover deterministically).
+
+Two pieces, both pure host-side policy (no compiled graph changes):
+
+- :class:`QuantumWatchdog` — a per-quantum wall-clock deadline derived
+  from the engine's OWN quantum-seconds distribution: deadline(kind) =
+  p99(kind) x ``deadline_margin``, gated on ``min_samples``
+  observations and floored at ``min_deadline_s``. It owns a PRIVATE
+  :class:`~paddle_tpu.obs.registry.Histogram` (not the obs registry's)
+  so it works under ``obs="off"`` and never double-counts the exported
+  ``serving_quantum_seconds`` series. Dispatch is synchronous, so the
+  watchdog is detection-only: an overrun trips AFTER the quantum
+  returns, feeding the trips counter and the spec-disable degradation
+  ladder rather than interrupting the dispatch.
+- :class:`ResiliencePolicy` — the knobs: retry budget + exponential
+  backoff for :class:`~paddle_tpu.serving.faults.InjectedFault`
+  retries, the watchdog's margin/floor/min-samples, and the
+  ``spec_fault_threshold`` at which repeated spec-round faults
+  auto-disable speculative decoding (degrading to the plain quantum —
+  same compiled executable, no new golden). ``sleep`` is injectable so
+  tests assert backoff schedules without wall-clock waits.
+"""
+from __future__ import annotations
+
+import time
+
+from ..obs.registry import Histogram
+
+__all__ = ["ResiliencePolicy", "QuantumWatchdog"]
+
+
+class ResiliencePolicy:
+    """Knobs for the engine's fault handling (``resilience=True``
+    builds the stock policy).
+
+    Args:
+        max_retries: injected-fault retries per dispatch before the
+            engine escalates (poison -> bisect quarantine; transient ->
+            skip the step and let the next step retry naturally).
+        backoff_base_s / backoff_mult: exponential backoff between
+            retries — retry i sleeps ``base * mult**i``.
+        deadline_margin: watchdog deadline = p99 x margin.
+        min_deadline_s: floor under the p99-derived deadline (tiny CPU
+            quanta would otherwise trip on scheduler jitter).
+        min_samples: observations per quantum kind before the watchdog
+            arms (no deadline until the histogram is warm).
+        spec_fault_threshold: spec-round faults/trips before the
+            engine one-way degrades to the plain quantum.
+        sleep: injectable stall fn for the backoff (tests pass a stub).
+    """
+
+    def __init__(self, max_retries=3, backoff_base_s=0.01,
+                 backoff_mult=2.0, deadline_margin=20.0,
+                 min_deadline_s=0.25, min_samples=16,
+                 spec_fault_threshold=3, sleep=time.sleep):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if spec_fault_threshold < 1:
+            raise ValueError("spec_fault_threshold must be >= 1")
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_mult = float(backoff_mult)
+        self.deadline_margin = float(deadline_margin)
+        self.min_deadline_s = float(min_deadline_s)
+        self.min_samples = int(min_samples)
+        self.spec_fault_threshold = int(spec_fault_threshold)
+        self.sleep = sleep
+
+    def backoff_s(self, attempt):
+        """Stall before retry ``attempt`` (0-based)."""
+        return self.backoff_base_s * (self.backoff_mult ** attempt)
+
+
+class QuantumWatchdog:
+    """Wall-clock overrun detection per quantum kind, self-calibrated
+    from the engine's own latency distribution."""
+
+    def __init__(self, policy=None):
+        self.policy = policy if policy is not None else ResiliencePolicy()
+        # private histogram: independent of any obs registry so the
+        # watchdog works under obs="off" and the exported
+        # serving_quantum_seconds series is never double-counted
+        self._hist = Histogram("watchdog_quantum_seconds")
+        self.trips_total = 0
+        self.trips = {}  # kind -> count
+
+    def observe(self, kind, dt):
+        self._hist.observe(float(dt), kind=str(kind))
+
+    def deadline(self, kind):
+        """Current deadline for ``kind`` in seconds, or None while the
+        histogram is cold (fewer than ``min_samples`` observations)."""
+        if self._hist.count(kind=str(kind)) < self.policy.min_samples:
+            return None
+        p99 = self._hist.quantile(0.99, kind=str(kind))
+        if p99 is None:
+            return None
+        return max(p99 * self.policy.deadline_margin,
+                   self.policy.min_deadline_s)
+
+    def check(self, kind, elapsed):
+        """Record ``elapsed`` then test it against the deadline that
+        held BEFORE this observation; returns True on a trip."""
+        limit = self.deadline(kind)
+        self.observe(kind, elapsed)
+        if limit is not None and elapsed > limit:
+            self.trips_total += 1
+            self.trips[kind] = self.trips.get(kind, 0) + 1
+            return True
+        return False
+
+    def stats(self):
+        return {"trips_total": self.trips_total,
+                "trips": dict(self.trips)}

@@ -110,7 +110,8 @@ class Request:
         self.preemptions = 0
         self.tokens: list = []        # generated token ids (incl. eos)
         self.finished = False
-        self.finish_reason = None     # "eos" | "stop" | "length" | "shed"
+        self.finish_reason = None     # "eos" | "stop" | "length" |
+        #   "shed" (refused admission) | "error" (quarantined/failed)
         self.admit_time = None
         self.first_token_time = None
         self.finish_time = None

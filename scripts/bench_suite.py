@@ -12,7 +12,8 @@ sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
 llama_7b_shape_b2_train | llama_7b_shape_longctx | moe_dispatch |
 serving_engine | speculative_decode | speculative_serving |
-serving_obs_overhead | attribution_overhead | slo_overhead |
+serving_obs_overhead | fault_recovery_overhead |
+attribution_overhead | slo_overhead |
 serving_overload |
 shared_prefix | serving_tp
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
@@ -973,6 +974,16 @@ def serving_obs_overhead():
     return _bench_serving().serving_obs_overhead()
 
 
+def fault_recovery_overhead():
+    """Resilience-tier price when nothing goes wrong (ISSUE 13):
+    guarded dispatch + quantum watchdog + per-step pool audit live
+    with the fault injector DISARMED vs the plain obs="off" engine —
+    same <3% bar and fingerprint-identical quantum as
+    serving_obs_overhead (see scripts/bench_serving.py, artifact
+    BENCH_RESILIENCE_r14.json)."""
+    return _bench_serving().fault_recovery_overhead()
+
+
 def attribution_overhead():
     """Cost-ledger cost gate (ISSUE 10): decode-quantum throughput
     with the per-token attribution ledger live vs the same fully-
@@ -1027,6 +1038,7 @@ CONFIGS = {
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,
     "serving_obs_overhead": serving_obs_overhead,
+    "fault_recovery_overhead": fault_recovery_overhead,
     "attribution_overhead": attribution_overhead,
     "slo_overhead": slo_overhead,
     "serving_overload": serving_overload,

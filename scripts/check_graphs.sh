@@ -56,6 +56,17 @@ python -m paddle_tpu.analysis --check --fingerprint
 # host callbacks + donation; the tp=1 recipes' goldens must stay
 # byte-identical (the mesh enters only through the tp recipe). The
 # CLI re-execs with 8 virtual CPU devices when the host exposes fewer.
+#
+# Resilience gate (ISSUE 13): the recipe engines above now carry a
+# DISARMED FaultInjector (faults.py threads every host boundary), so
+# the `--check --fingerprint` pass doubles as the proof that the
+# fault-injection seams change no compiled graph: 0 host callbacks
+# and byte-identical goldens with the injector present. `obs check`
+# then runs the bounded chaos-soak smoke (~30 s): a seeded
+# faults x preemption x COW run where every non-poisoned stream must
+# stay bit-exact vs the fault-free arm and the pools must drain to
+# zero leaked blocks; the full 200-round soak lives in
+# tests/test_resilience.py (slow) and scripts/soak.py.
 python -m paddle_tpu.obs check
 # Perf sentinel (ISSUE 10): the runtime twin of the graph gate —
 # validate/index the BENCH_*.json trajectory and enforce the declared

@@ -252,3 +252,128 @@ def test_frontdoor_pump_preemption_e2e(tiny_model):
     assert len(low.request.tokens) == 6
     assert low.request.preemptions == 1
     assert fd.engine.pool.fragmentation_stats()["blocks_in_use"] == 1
+
+
+# ------------------------------------------------ resilience (ISSUE 13)
+def test_stream_timeout_kwarg(tiny_model):
+    """``submit(..., timeout=)`` bounds each token wait: a starved
+    stream raises TimeoutError instead of pumping forever, and a
+    stream whose tokens keep arriving never notices its timeout."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(3)
+    # no_shed: the first pump's jit-compile TTFT would otherwise read
+    # critical and shed the NORMAL submissions below
+    fd = inference.serve(model, num_slots=1, block_size=4,
+                         prefill_chunk=8, policy=no_shed_policy())
+    busy = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                     .astype(np.int32), max_new_tokens=3,
+                     priority=NORMAL)
+    starved = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                        .astype(np.int32), max_new_tokens=1,
+                        priority=NORMAL, timeout=1e-4)
+    with pytest.raises(TimeoutError, match="no token"):
+        list(starved)
+    # the raise is per-gap, not terminal: once the slot frees, the
+    # same stream drains normally
+    ok = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                   .astype(np.int32), max_new_tokens=1,
+                   priority=NORMAL, timeout=30.0)
+    assert list(busy) == busy.request.tokens
+    assert len(list(starved)) + len(starved.request.tokens) >= 1
+    assert len(list(ok)) == 1
+    fd.drain()
+
+
+def test_quarantined_stream_reaped(tiny_model):
+    """A poisoned request emits no closing token — the front door's
+    finished-stream reap must close its stream anyway (consumer loop
+    ends, finish_reason="error"), while other streams drain normally."""
+    from paddle_tpu.serving import FaultInjector
+
+    cfg, model = tiny_model
+    rng = np.random.RandomState(4)
+    inj = FaultInjector(seed=0)
+    fd = inference.serve(model, num_slots=2, block_size=4,
+                         prefill_chunk=8, faults=inj, resilience=True)
+    good = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                     .astype(np.int32), max_new_tokens=2)
+    bad = fd.submit(rng.randint(1, cfg.vocab_size, 7)
+                    .astype(np.int32), max_new_tokens=2)
+    inj.poison(bad.request.req_id)
+    fd.run_until_idle()
+    assert bad.request.finish_reason == "error"
+    assert bad.closed and list(bad) == []
+    assert good.finish_reason == "length"
+    assert len(good.request.tokens) == 2
+    assert fd.engine.resilience_report()["quarantined"] == [
+        str(bad.request.req_id)]
+    fd.drain()
+
+
+def test_pump_failure_fails_open_streams(tiny_model, monkeypatch):
+    """A REAL engine exception out of a pump fails every open stream
+    terminally (finish_reason="error") and re-raises to the pumping
+    consumer — nobody blocks on a dead engine."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(5)
+    fd = inference.serve(model, num_slots=2, block_size=4,
+                         prefill_chunk=8)
+    s0 = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                   .astype(np.int32), max_new_tokens=1)
+    s1 = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                   .astype(np.int32), max_new_tokens=1)
+
+    def boom():
+        raise RuntimeError("engine died")
+    monkeypatch.setattr(fd.engine, "step", boom)
+    with pytest.raises(RuntimeError, match="engine died"):
+        list(s0)
+    assert s0.closed and s1.closed
+    assert s0.finish_reason == "error" and s1.finish_reason == "error"
+    assert fd._streams == {}
+
+
+def test_orphaned_stream_error_closes(tiny_model):
+    """A stream whose request fell out of an IDLE engine closes with
+    finish_reason="error" instead of spinning on pump forever."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(6)
+    fd = inference.serve(model, num_slots=2, block_size=4,
+                         prefill_chunk=8)
+    s = fd.submit(rng.randint(1, cfg.vocab_size, 5)
+                  .astype(np.int32), max_new_tokens=1)
+    fd.engine.scheduler.waiting.remove(s.request)   # simulate the drop
+    assert list(s) == []
+    assert s.closed and s.finish_reason == "error"
+
+
+def test_frontdoor_snapshot_restore_streams(tiny_model):
+    """Crash recovery through the front door: restore() re-opens every
+    in-flight stream pre-loaded with its already-emitted tokens, and
+    consumers of the restored streams see the FULL bit-exact
+    sequences."""
+    cfg, model = tiny_model
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size, 5).astype(np.int32)
+               for _ in range(2)]
+    ref = ServingEngine(model, num_slots=2, block_size=4,
+                        prefill_chunk=8, decode_quantum=2)
+    want = [list(ref.submit(p, max_new_tokens=4).tokens) or None
+            for p in prompts]
+    ref.run()
+    want = [list(r.tokens) for r in ref.completed]
+
+    fd = inference.serve(model, num_slots=2, block_size=4,
+                         prefill_chunk=8, decode_quantum=2)
+    streams = [fd.submit(p, max_new_tokens=4) for p in prompts]
+    while not any(s.request.tokens for s in streams):
+        fd.pump()
+    snap = json.loads(json.dumps(fd.snapshot()))
+    fd2 = ServingFrontDoor.restore(snap, model)
+    restored = list(fd2._streams.values())
+    assert len(restored) == 2
+    got = {str(s.request.req_id): list(s) for s in restored}
+    ids = [str(s.request.req_id) for s in streams]
+    assert [got[i] for i in ids] == want
+    assert all(s.finish_reason == "length" for s in restored)
+    fd2.drain()

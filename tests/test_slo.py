@@ -625,3 +625,31 @@ def test_watch_cli_offline_frame(tmp_path, capsys):
     assert "serving health" in out and "[OK] ok" in out
     assert out.count("submitted") == 1  # exactly one frame
     assert main(["watch"]) == 2
+
+
+def test_exporter_handler_error_returns_500_json():
+    """ISSUE 13 satellite: a broken render must not kill the server
+    thread OR pass silently — the scrape gets an HTTP 500 with a JSON
+    error body, and ``exporter_errors_total`` counts it (so the
+    failure shows up in the very next successful scrape)."""
+    registry = MetricsRegistry()
+
+    class _Boom:
+        def evaluate(self, *a, **k):
+            raise ValueError("kaboom")
+
+    exporter = MetricsExporter(registry, slos=_Boom()).start()
+    try:
+        status, body = _get(exporter.url("/slo"))
+        assert status == 500
+        err = json.loads(body)
+        assert err == {"error": "ValueError: kaboom"}
+        status, body = _get(exporter.url("/healthz"))
+        assert status == 500
+        assert registry.get("exporter_errors_total").value() == 2.0
+        # the endpoint survived: a healthy route still serves, and the
+        # error counter rides the scrape
+        status, prom = _get(exporter.url("/metrics"))
+        assert status == 200 and "exporter_errors_total 2" in prom
+    finally:
+        exporter.stop()
