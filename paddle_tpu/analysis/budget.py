@@ -32,7 +32,8 @@ _BUDGET_FIELDS = (
     "max_remat", "max_all_gathers", "max_all_reduces",
     "max_reduce_scatters", "max_all_to_alls", "max_collective_permutes",
     "max_total_collectives", "max_collective_bytes", "max_f32_matmuls",
-    "max_f32_upcasts", "max_undonated_bytes", "max_host_callbacks",
+    "max_f32_upcasts", "min_int8_matmuls", "max_undonated_bytes",
+    "max_host_callbacks",
     "max_temp_bytes", "max_peak_live_bytes", "max_output_bytes",
     "max_replicated_param_bytes", "min_sharded_params",
     "require_donated", "require_reduce_scatter", "require_all_gather",
@@ -62,6 +63,9 @@ class Budget:
         max_f32_matmuls: f32 dot/conv ops reachable from bf16/f16
             values (0 = a bf16 graph stays bf16 on the MXU path).
         max_f32_upcasts: bf16/f16 -> f32 convert ops.
+        min_int8_matmuls: at LEAST this many dot/conv ops reachable
+            from int8 storage (weights or KV pools) — positive
+            evidence a quantized graph actually runs quantized.
         max_undonated_bytes: bytes of donatable args left undonated.
         max_host_callbacks: python-callback custom-calls plus
             infeed/outfeed/host send-recv ops in the compiled module
@@ -174,6 +178,10 @@ class AuditReport:
                 f"{self.dtype.upcasts}")
             for ev in self.dtype.f32_compute[:4]:
                 lines.append(f"    {ev!r}")
+            if getattr(self.dtype, "int8_compute", None):
+                lines.append(
+                    f"  matmul/conv fed from int8 storage: "
+                    f"{len(self.dtype.int8_compute)}")
         if self.host_sync is not None:
             lines.append(
                 f"  host syncs: {self.host_sync.count} "
@@ -247,8 +255,16 @@ def check_budget(target, budget, *args, **kwargs):
             "f32 matmul/conv reachable from bf16")
         cap(budget.max_f32_upcasts, report.dtype.upcasts,
             "bf16->f32 upcasts")
+        if budget.min_int8_matmuls is not None \
+                and len(report.dtype.int8_compute) \
+                < budget.min_int8_matmuls:
+            v.append(
+                f"matmul/conv reachable from int8: "
+                f"{len(report.dtype.int8_compute)} < budget minimum "
+                f"{budget.min_int8_matmuls}")
     elif budget.max_f32_matmuls is not None \
-            or budget.max_f32_upcasts is not None:
+            or budget.max_f32_upcasts is not None \
+            or budget.min_int8_matmuls is not None:
         v.append("dtype budget set but target offers no jaxpr to audit")
     cap(budget.max_undonated_bytes, report.donation.undonated_bytes,
         "undonated donatable bytes")

@@ -18,6 +18,14 @@ op provenance is still legible) with a taint dataflow:
 Intentional f32 islands (loss logsumexp, optimizer master math on f32
 state) don't trip it: their inputs are either untainted f32 state or
 the flagged op set is matmul/conv only, not elementwise.
+
+A SECOND, independent taint runs for int8 sources (quantized serving:
+int8 weights, int8 KV pools): every matmul/conv reachable from an int8
+input/const is collected in ``DtypeReport.int8_compute`` — the
+POSITIVE evidence a quantized graph actually feeds its contractions
+from int8 storage (budgets assert a MINIMUM via ``min_int8_matmuls``,
+the inverse direction of the f32 cap). Kept out of the fingerprint
+dict so pre-int8 goldens stay byte-identical.
 """
 from __future__ import annotations
 
@@ -28,6 +36,7 @@ __all__ = ["DtypeReport", "F32ComputeEvent", "audit_dtype_promotion"]
 
 _COMPUTE_PRIMS = ("dot_general", "conv_general_dilated")
 _SOURCE_DTYPES = (jnp.bfloat16, jnp.float16)
+_I8_SOURCE_DTYPES = (jnp.int8,)
 
 
 class F32ComputeEvent:
@@ -48,13 +57,17 @@ class F32ComputeEvent:
 
 
 class DtypeReport:
-    __slots__ = ("f32_compute", "upcasts")
+    __slots__ = ("f32_compute", "upcasts", "int8_compute")
 
-    def __init__(self, f32_compute, upcasts):
+    def __init__(self, f32_compute, upcasts, int8_compute=None):
         #: list[F32ComputeEvent]
         self.f32_compute = f32_compute
         #: count of bf16/f16 -> f32 convert_element_type equations
         self.upcasts = upcasts
+        #: list[F32ComputeEvent] — matmuls/convs fed (transitively)
+        #: from int8 storage; evidence the quantized path is live
+        self.int8_compute = int8_compute if int8_compute is not None \
+            else []
 
 
 def _sub_jaxprs(eqn):
@@ -72,7 +85,10 @@ def _sub_jaxprs(eqn):
     return out
 
 
-def _walk(jaxpr, tainted, events, path, seen_upcasts):
+def _walk(jaxpr, tainted, events, path, seen_upcasts,
+          i8_tainted=None, i8_events=None):
+    if i8_tainted is None:
+        i8_tainted = set()
     for eqn in jaxpr.eqns:
         in_taint = [
             (isinstance(v, jax.core.Var) and v in tainted)
@@ -80,6 +96,11 @@ def _walk(jaxpr, tainted, events, path, seen_upcasts):
             for v in eqn.invars
         ]
         any_taint = any(in_taint)
+        in_i8 = [
+            isinstance(v, jax.core.Var) and v in i8_tainted
+            for v in eqn.invars
+        ]
+        any_i8 = any(in_i8)
         prim = eqn.primitive.name
 
         if prim == "convert_element_type":
@@ -103,27 +124,48 @@ def _walk(jaxpr, tainted, events, path, seen_upcasts):
                     path=path,
                 ))
 
+        if prim in _COMPUTE_PRIMS and any_i8 and i8_events is not None:
+            out_aval = _aval(eqn.outvars[0])
+            i8_events.append(F32ComputeEvent(
+                primitive=prim,
+                out_shape=(out_aval.shape if out_aval is not None
+                           else ()),
+                in_dtypes=[
+                    str(_aval(v).dtype) if _aval(v) is not None else "?"
+                    for v in eqn.invars
+                ],
+                path=path,
+            ))
+
         for closed, sub in _sub_jaxprs(eqn):
             sub_taint = set()
+            sub_i8 = set()
             # align sub invars with eqn invars from the end: leading
             # extras on either side are consts/predicates
             n = min(len(sub.invars), len(eqn.invars))
-            for sv, ev, et in zip(sub.invars[-n:], eqn.invars[-n:],
-                                  in_taint[-n:]):
+            for sv, ev, et, e8 in zip(sub.invars[-n:], eqn.invars[-n:],
+                                      in_taint[-n:], in_i8[-n:]):
                 if et or _is_source_lit(ev):
                     sub_taint.add(sv)
+                if e8:
+                    sub_i8.add(sv)
             # consts of a closed jaxpr can be bf16 arrays too
             consts = getattr(closed, "consts", None) or []
             for cv, c in zip(getattr(sub, "constvars", []), consts):
                 if getattr(c, "dtype", None) in _SOURCE_DTYPES:
                     sub_taint.add(cv)
+                if getattr(c, "dtype", None) in _I8_SOURCE_DTYPES:
+                    sub_i8.add(cv)
             sub_path = f"{path}/{prim}" if path else prim
-            _walk(sub, sub_taint, events, sub_path, seen_upcasts)
+            _walk(sub, sub_taint, events, sub_path, seen_upcasts,
+                  sub_i8, i8_events)
             # outputs of a sub-jaxpr-carrying eqn: tainted if any input
             # was (conservative but local)
 
         if any_taint:
             tainted.update(eqn.outvars)
+        if any_i8:
+            i8_tainted.update(eqn.outvars)
 
 
 def _aval(v):
@@ -140,17 +182,25 @@ def _is_source_lit(v):
 def audit_dtype_promotion(closed_jaxpr):
     """Run the taint walk over a ClosedJaxpr; returns
     :class:`DtypeReport`. Taint sources are every bf16/f16 input and
-    const."""
+    const (f32-promotion direction) and every int8 input and const
+    (quantized-compute evidence direction)."""
     jaxpr = closed_jaxpr.jaxpr
     tainted = set()
+    i8_tainted = set()
     for v in jaxpr.invars:
         a = _aval(v)
-        if a is not None and getattr(a, "dtype", None) in _SOURCE_DTYPES:
+        dt = getattr(a, "dtype", None) if a is not None else None
+        if dt in _SOURCE_DTYPES:
             tainted.add(v)
+        if dt in _I8_SOURCE_DTYPES:
+            i8_tainted.add(v)
     for cv, c in zip(jaxpr.constvars, closed_jaxpr.consts):
         if getattr(c, "dtype", None) in _SOURCE_DTYPES:
             tainted.add(cv)
+        if getattr(c, "dtype", None) in _I8_SOURCE_DTYPES:
+            i8_tainted.add(cv)
     events = []
+    i8_events = []
     upcasts = [0]
-    _walk(jaxpr, tainted, events, "", upcasts)
-    return DtypeReport(events, upcasts[0])
+    _walk(jaxpr, tainted, events, "", upcasts, i8_tainted, i8_events)
+    return DtypeReport(events, upcasts[0], i8_events)

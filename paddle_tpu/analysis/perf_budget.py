@@ -397,4 +397,16 @@ def default_perf_budgets():
                    "to 1.0, so no noise band; step time on the CPU "
                    "smoke is informational (two virtual devices on "
                    "one core)"),
+        PerfBudget(
+            "int8-pool-residency", "BENCH_INT8_r15.json",
+            "serving_int8_pool_residency_ratio_cpu_smoke",
+            floor=3.0, noise_frac=0.0,
+            reason="float/int8 KV pool residency is EXACTLY "
+                   "(4d)/(d+4) = 3.2 by construction at the smoke's "
+                   "head_dim 16 (int8 rows + per-row f32 scales, "
+                   "same block count at the deterministic allocation "
+                   "point) — a silent float fallback decays it to "
+                   "1.0, so no noise band; the weight-only arm's "
+                   "bit-identical dequant-oracle streams are "
+                   "asserted inside the row itself"),
     ]

@@ -40,6 +40,13 @@ known-good graph shape.
   the machine proof that the whole cache policy (chain-hash index,
   attach/publish, COW, refcount eviction) is host-side allocator work
   that never changes the compiled program.
+- ``serving_int8_step``: the QUANTIZED engine's decode quantum
+  (``quantize="weight_only_int8"`` + ``kv_dtype="int8"`` — int8
+  weights dequantized into the matmul, int8 KV pool with per-row
+  scale pools in the donated signature). Budget: the serving caps
+  plus ``min_int8_matmuls`` — positive, machine-checked evidence the
+  contractions are fed from int8 storage, so "quantization silently
+  disabled" cannot pass tier-1 even though it would be bit-identical.
 
 ``build(name)`` constructs the recipe (installing the mesh it needs)
 and returns a :class:`Recipe`; call ``recipe.check()`` for the audited
@@ -374,6 +381,58 @@ def _build_serving_prefix_step():
     return recipe
 
 
+def _build_serving_int8_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import FaultInjector, ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    # the QUANTIZED serving quantum: weight-only int8 (per-out-channel
+    # scales, dequant INTO the matmul) + int8 KV pool with per-row f32
+    # scale pools riding the quantum signature. Same observability /
+    # resilience tier as serving_decode_step. The budget adds the
+    # INVERSE dtype direction: ``min_int8_matmuls`` asserts the
+    # contractions really are fed from int8 storage — a refactor that
+    # silently dequantizes weights at build (or floats the pool) keeps
+    # every stream bit-identical yet blows this budget.
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=4,
+                           quantize="weight_only_int8",
+                           kv_dtype="int8",
+                           trace=True, slo=True, flight=True,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
+    rng = np.random.RandomState(0)
+    engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
+                  max_new_tokens=8)
+    engine.step()  # admit + prefill so the audited state is live
+    target, args = engine.decode_step_target()
+    budget = Budget(
+        name="int8 serving decode quantum (w8 + kv8, single chip)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip serving program
+        max_host_callbacks=0,     # host scheduler only at boundaries
+        require_donated=True,     # KV pools AND their scale pools
+        # every decode-step matmul (qkv/out/ffn x layers + lm head)
+        # must trace back to int8 weights or the int8 KV pool. Audited
+        # 19 int8-fed contractions; the floor catches "quantization
+        # silently off" (=0) and any per-layer partial disable
+        min_int8_matmuls=10,
+        # audited 613 KB temp / 286 KB trace peak: the gather-dequant
+        # attention fallback plus in-graph per-row quant temporaries
+        # cost more compiled scratch than the bf16 quantum's Pallas
+        # path; same ~30% headroom discipline as the other recipes
+        max_temp_bytes=800_000,
+        max_peak_live_bytes=450_000,
+    )
+    recipe = Recipe("serving_int8_step", target, args, budget)
+    recipe.engine = engine  # obs CLI asserts the instrumented engine
+    return recipe
+
+
 def _build_serving_tp_step():
     import numpy as np
     import paddle_tpu as paddle
@@ -438,6 +497,7 @@ RECIPES = {
     "speculative_verify_step": _build_speculative_verify_step,
     "serving_frontdoor_step": _build_serving_frontdoor_step,
     "serving_prefix_step": _build_serving_prefix_step,
+    "serving_int8_step": _build_serving_int8_step,
     "serving_tp_step": _build_serving_tp_step,
 }
 

@@ -259,6 +259,24 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         raise ValueError(
             "int8 KV cache needs BOTH cache_k_quant_scales and "
             "cache_v_quant_scales")
+    # DYNAMIC per-row scale pools (the serving engine's int8 paged
+    # pools): (num_blocks, block_size, HK) float32 Tensors holding one
+    # symmetric abs-max scale per written row. This call quantizes its
+    # new tokens' K/V rows in-graph, scatters the scales beside the
+    # int8 values, and dequantizes every gathered context row by its
+    # OWN scale — and mutates the scale-pool Tensors in place exactly
+    # like key_cache/value_cache.
+    cache_k_sp = kwargs.get("cache_k_scale_pool")
+    cache_v_sp = kwargs.get("cache_v_scale_pool")
+    dyn_quant = cache_k_sp is not None or cache_v_sp is not None
+    if dyn_quant and (cache_k_sp is None or cache_v_sp is None):
+        raise ValueError(
+            "dynamic int8 KV cache needs BOTH cache_k_scale_pool and "
+            "cache_v_scale_pool")
+    if dyn_quant and quant_cache:
+        raise ValueError(
+            "pass either static cache_k/v_quant_scales or per-row "
+            "cache_k/v_scale_pool, not both")
     # rope/bias fusion (reference contract: applied INSIDE the op, to
     # this call's new q/k tokens at their absolute cache positions):
     #   rotary_embs: (2, max_seq_len, head_dim//2) — [0]=cos, [1]=sin
@@ -268,19 +286,23 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     qkv = ensure_tensor(qkv)
     key_cache = ensure_tensor(key_cache)
     value_cache = ensure_tensor(value_cache)
+    if dyn_quant:
+        cache_k_sp = ensure_tensor(cache_k_sp)
+        cache_v_sp = ensure_tensor(cache_v_sp)
     kc_dt = str(key_cache._value.dtype)
     vc_dt = str(value_cache._value.dtype)
     if kc_dt != vc_dt:
         raise ValueError(
             f"key_cache ({kc_dt}) and value_cache ({vc_dt}) dtypes "
             f"must match")
-    if quant_cache and kc_dt != "int8":
+    if (quant_cache or dyn_quant) and kc_dt != "int8":
         raise ValueError(
-            f"cache_k/v_quant_scales given but the cache pools are "
+            f"cache quant scales given but the cache pools are "
             f"{kc_dt}, not int8")
-    if not quant_cache and kc_dt == "int8":
+    if not quant_cache and not dyn_quant and kc_dt == "int8":
         raise ValueError(
-            "int8 cache pools need cache_k/v_quant_scales")
+            "int8 cache pools need cache_k/v_quant_scales or "
+            "cache_k/v_scale_pool")
     if num_heads is None or kv_num_heads is None:
         raise ValueError(
             "block_multihead_attention requires num_heads/kv_num_heads "
@@ -311,6 +333,12 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     enc_lens = np.asarray(ensure_tensor(seq_lens_encoder)._value)
     active = this_time > 0  # finished/inactive slots contribute nothing
     is_prefill_row = ((this_time > 1) | (enc_lens > 0)) & active
+    if dyn_quant:
+        # per-row scale pools: the Pallas paged-decode kernel only
+        # supports STATIC per-head scales, so decode rows route through
+        # the varlen gather path as 1-token prefill rows (bottom-right
+        # causal alignment attends their full dequantized context)
+        is_prefill_row = active
     cu_all = np.concatenate([[0], np.cumsum(this_time)]).astype(np.int32)
     tbl_np = np.asarray(tables)
 
@@ -378,6 +406,8 @@ def block_multihead_attention(qkv, key_cache, value_cache,
 
     def fn(qkv_v, kp, vp, *fused):
         fused = list(fused)
+        ksp = fused.pop(0) if dyn_quant else None
+        vsp = fused.pop(0) if dyn_quant else None
         rot = fused.pop(0) if rotary_embs is not None else None
         bias = fused.pop(0) if qkv_bias is not None else None
         if qkv_scale_v is not None:
@@ -396,7 +426,19 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                                  position_ids=abs_pos_j[None])[0]
             k_new = apply_rotary_emb(k_new[None], cos, sin, neox=neox,
                                      position_ids=abs_pos_j[None])[0]
-        if quant_cache:
+        ksp2 = vsp2 = None
+        if dyn_quant:
+            from ....nn.quant import quantize_kv_rows
+
+            # per-row symmetric quant — the SAME helper the serving
+            # quantum's write sites use, so a token's quantized pool row
+            # (value AND scale) is identical no matter which path
+            # (chunked prefill, decode quantum, spec round) wrote it
+            k_store, k_sc = quantize_kv_rows(k_new)   # (T,HK,D)/(T,HK)
+            v_store, v_sc = quantize_kv_rows(v_new)
+            ksp2 = ksp.at[blk_ids, offs].set(k_sc)
+            vsp2 = vsp.at[blk_ids, offs].set(v_sc)
+        elif quant_cache:
             k_store = jnp.clip(
                 jnp.round(k_new.astype(jnp.float32)
                           * k_qs_v[None, :, None]), -128, 127
@@ -417,7 +459,12 @@ def block_multihead_attention(qkv, key_cache, value_cache,
             # the updated pool
             k_ctx = kp2[ctx_blk, ctx_off]
             v_ctx = vp2[ctx_blk, ctx_off]
-            if quant_cache:
+            if dyn_quant:
+                k_ctx = (k_ctx.astype(jnp.float32)
+                         * ksp2[ctx_blk, ctx_off][..., None])
+                v_ctx = (v_ctx.astype(jnp.float32)
+                         * vsp2[ctx_blk, ctx_off][..., None])
+            elif quant_cache:
                 k_ctx = k_ctx.astype(jnp.float32) * k_ds_v[None, :, None]
                 v_ctx = v_ctx.astype(jnp.float32) * v_ds_v[None, :, None]
             k_ctx = k_ctx.astype(q.dtype)
@@ -442,13 +489,28 @@ def block_multihead_attention(qkv, key_cache, value_cache,
             out_flat = jnp.clip(
                 jnp.round(out_flat.astype(jnp.float32) / out_scale_f),
                 -128, 127).astype(jnp.int8)
+        if dyn_quant:
+            return out_flat, kp2, vp2, ksp2, vsp2
         return out_flat, kp2, vp2
 
     fused_args = []
+    if dyn_quant:
+        fused_args.append(cache_k_sp)
+        fused_args.append(cache_v_sp)
     if rotary_embs is not None:
         fused_args.append(ensure_tensor(rotary_embs))
     if qkv_bias is not None:
         fused_args.append(ensure_tensor(qkv_bias))
+    if dyn_quant:
+        out, new_k, new_v, new_ks, new_vs = apply(
+            fn, qkv, key_cache, value_cache, *fused_args,
+            op_name="block_multihead_attention",
+        )
+        key_cache._value = new_k._value
+        value_cache._value = new_v._value
+        cache_k_sp._value = new_ks._value
+        cache_v_sp._value = new_vs._value
+        return out
     out, new_k, new_v = apply(
         fn, qkv, key_cache, value_cache, *fused_args,
         op_name="block_multihead_attention",

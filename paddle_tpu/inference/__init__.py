@@ -192,6 +192,22 @@ def create_serving_engine(model, **kwargs):
     boundaries for deterministic chaos testing (default disarmed —
     byte-identical goldens).
 
+    QUANTIZED SERVING: ``quantize="weight_only_int8"`` sweeps every
+    Linear (incl. the TP column/row-parallel splits) to the
+    weight-only int8 kernel at build — the dequant multiplies INTO
+    the matmul per element, so streams are BIT-IDENTICAL to a float
+    engine holding the dequantized matrices — and ``kv_dtype="int8"``
+    stores the paged KV pool as int8 rows + per-row f32 scale pools
+    (quantized in-graph at every write, dequantized in-kernel at
+    attention; ~4x less pool residency per block at large head_dim).
+    The two axes are independent and COMPOUND with everything above:
+    prefix sharing/COW, preemption, speculation (the draft pool
+    quantizes in lockstep) and TP's per-chip split all operate on the
+    smaller blocks, and the dtype-labeled ``serving_pool_bytes``
+    gauges report the live residency. NOTE: the quantize sweep
+    rewrites the model's Linears in place — hand each quantized
+    engine its own freshly built model.
+
     TENSOR-PARALLEL SERVING: pass ``tp=2`` (or an explicit ``mesh=``
     with an ``"mp"`` axis) to shard the whole quantum family over the
     device mesh — params split along heads/ffn, paged KV pools split
@@ -230,6 +246,10 @@ def serve(model, policy=None, slo=True, flight=True, **kwargs):
     per-request win. ``tp=2`` / ``mesh=`` shard the engine's quantum
     over the device mesh (tensor-parallel model required; streams stay
     bit-exact — :func:`create_serving_engine` documents the setup).
+    ``quantize="weight_only_int8"`` / ``kv_dtype="int8"`` serve int8
+    weights and an int8 KV pool (bit-identical streams vs the
+    dequantized-float engine; residency compounds with prefix sharing
+    and TP — :func:`create_serving_engine` documents the sweep).
     ``resilience=True`` arms the watchdog/retry/quarantine tier and
     makes the front door crash-recoverable
     (``fd.snapshot()`` / ``ServingFrontDoor.restore(snap, model)``

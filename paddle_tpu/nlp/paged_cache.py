@@ -78,6 +78,16 @@ class PagedKVCachePool:
         block_size: tokens per block (lane-friendly: 16/32/64...).
         num_kv_heads, head_dim, num_layers: cache geometry.
         dtype: cache dtype (bf16 for serving).
+        kv_dtype: ``"int8"`` switches the block buffers to int8 and
+            grows per-layer SCALE POOLS ``k_scales``/``v_scales`` of
+            shape (num_blocks, block_size, num_kv_heads) float32 — one
+            symmetric abs-max quant scale per written KV row, computed
+            in-graph at every write site and consumed by the in-kernel
+            dequant. Scale rows travel with their block: COW copies
+            them, sharing aliases them, eviction reclaims them, and the
+            mesh layout pins their kv-head axis exactly like the block
+            buffers (``P(None, None, "mp")``). ``None`` keeps the
+            float pool.
         mesh: optional ``jax.sharding.Mesh`` with an ``"mp"`` axis. The
             pool arrays are placed head-sharded across it
             (``P(None, None, "mp", None)`` — each chip holds every
@@ -91,30 +101,60 @@ class PagedKVCachePool:
 
     def __init__(self, num_blocks, block_size, num_kv_heads, head_dim,
                  num_layers=1, dtype=jnp.bfloat16, prefix_cache=False,
-                 mesh=None):
+                 mesh=None, kv_dtype=None):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_kv_heads = int(num_kv_heads)
         self.head_dim = int(head_dim)
         self.num_layers = int(num_layers)
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unsupported kv_dtype {kv_dtype!r} (None or 'int8')")
+        self.kv_dtype = kv_dtype
         shape = (self.num_blocks, self.block_size, self.num_kv_heads,
                  self.head_dim)
         self.mesh = mesh
         self._pool_sharding = None
+        self._scale_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             mp = int(mesh.shape.get("mp", 1))
+            sharded = mp > 1 and self.num_kv_heads % mp == 0
             spec = (PartitionSpec(None, None, "mp", None)
-                    if mp > 1 and self.num_kv_heads % mp == 0
-                    else PartitionSpec())
+                    if sharded else PartitionSpec())
             self._pool_sharding = NamedSharding(mesh, spec)
-        self.k_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.v_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+            sspec = (PartitionSpec(None, None, "mp")
+                     if sharded else PartitionSpec())
+            self._scale_sharding = NamedSharding(mesh, sspec)
+        pool_dtype = jnp.int8 if self.quantized else dtype
+        self.k_pools = [jnp.zeros(shape, pool_dtype)
+                        for _ in range(num_layers)]
+        self.v_pools = [jnp.zeros(shape, pool_dtype)
+                        for _ in range(num_layers)]
         if self._pool_sharding is not None:
             self.k_pools = [jax.device_put(p, self._pool_sharding)
                             for p in self.k_pools]
             self.v_pools = [jax.device_put(p, self._pool_sharding)
                             for p in self.v_pools]
+        # per-row symmetric quant scales: one f32 per (block, position,
+        # kv head), written in-graph alongside every int8 KV row and
+        # consumed by the in-kernel dequant. Head axis pinned to the
+        # same mesh split as the block buffers.
+        if self.quantized:
+            sshape = (self.num_blocks, self.block_size,
+                      self.num_kv_heads)
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+            if self._scale_sharding is not None:
+                self.k_scales = [jax.device_put(s, self._scale_sharding)
+                                 for s in self.k_scales]
+                self.v_scales = [jax.device_put(s, self._scale_sharding)
+                                 for s in self.v_scales]
+        else:
+            self.k_scales = []
+            self.v_scales = []
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id -> list[int] block ids
         self._lens: dict = {}     # seq_id -> int tokens
@@ -145,6 +185,11 @@ class PagedKVCachePool:
         self.accounting_rebuilds = 0
         if prefix_cache:
             self.enable_prefix_cache()
+
+    @property
+    def quantized(self):
+        """True when the block buffers are int8 + per-row scale pools."""
+        return self.kv_dtype == "int8"
 
     # -- allocator ---------------------------------------------------------
     def _alloc_block(self):
@@ -366,6 +411,17 @@ class PagedKVCachePool:
                     self.k_pools[i][blk]))
                 self.v_pools[i] = self._pin(self.v_pools[i].at[fresh].set(
                     self.v_pools[i][blk]))
+                if self.quantized:
+                    # the scale rows ARE the block's content on a
+                    # quantized pool — a COW that left them behind
+                    # would let the writer's new scales corrupt the
+                    # sharer's dequantized values
+                    self.k_scales[i] = self._pin_scale(
+                        self.k_scales[i].at[fresh].set(
+                            self.k_scales[i][blk]))
+                    self.v_scales[i] = self._pin_scale(
+                        self.v_scales[i].at[fresh].set(
+                            self.v_scales[i][blk]))
             table[j] = fresh
             self._release([blk])
             copies += 1
@@ -398,8 +454,14 @@ class PagedKVCachePool:
         """Publish-time content checksum of one cached block: crc32
         over the layer-0 K rows (cheap; a cached block's pool content
         is immutable while cached — any write COWs first — so a
-        mismatch at attach time means real corruption)."""
-        return zlib.crc32(np.asarray(self.k_pools[0][blk]).tobytes())
+        mismatch at attach time means real corruption). On a quantized
+        pool the scale rows are part of the content identity: the same
+        int8 codes under different scales dequantize differently."""
+        crc = zlib.crc32(np.asarray(self.k_pools[0][blk]).tobytes())
+        if self.quantized:
+            crc = zlib.crc32(
+                np.asarray(self.k_scales[0][blk]).tobytes(), crc)
+        return crc
 
     def _verify_entries(self, entries):
         """Chain-hash verify-mismatch ladder: re-checksum each matched
@@ -658,6 +720,9 @@ class PagedKVCachePool:
             "utilization": (live / cap) if cap else 1.0,
             "shared_blocks": shared,
             "cached_blocks": len(self._cached_blocks),
+            "kv_dtype": str(self.k_pools[0].dtype),
+            "bytes_in_use": self.bytes_in_use(),
+            "per_chip_bytes_in_use": self.per_chip_bytes_in_use(),
         }
 
     def _pin(self, arr):
@@ -669,6 +734,12 @@ class PagedKVCachePool:
         if self._pool_sharding is None:
             return arr
         return jax.device_put(arr, self._pool_sharding)
+
+    def _pin_scale(self, arr):
+        """``_pin`` for the rank-3 scale pools (same head-axis split)."""
+        if self._scale_sharding is None:
+            return arr
+        return jax.device_put(arr, self._scale_sharding)
 
     @property
     def tp_shards(self):
@@ -682,9 +753,15 @@ class PagedKVCachePool:
 
     def bytes_in_use(self):
         """Live cache bytes — the paged-cache memory claim: scales with
-        allocated blocks, not batch × max_seq."""
+        allocated blocks, not batch × max_seq. Dtype-aware: computed
+        from the ACTUAL buffer itemsize (int8 pools report half a
+        bf16 pool's bytes) plus the scale-pool rows that travel with
+        each quantized block."""
         per_block = (self.block_size * self.num_kv_heads * self.head_dim
                      * self.k_pools[0].dtype.itemsize)
+        if self.quantized:
+            per_block += (self.block_size * self.num_kv_heads
+                          * self.k_scales[0].dtype.itemsize)
         return 2 * self.num_layers * self.blocks_in_use * per_block
 
     def per_chip_bytes_in_use(self):

@@ -27,6 +27,8 @@ __all__ = [
     "weight_quantize", "weight_dequantize", "weight_only_linear",
     "a8w8_linear",
     "QuantizedLinear",
+    "QuantizedColumnParallelLinear", "QuantizedRowParallelLinear",
+    "quantize_for_serving", "quantize_kv_rows",
 ]
 
 
@@ -107,6 +109,25 @@ def weight_quantize_stacked(w, axis=1):
     return q.astype(_jnp.int8), scale.astype(_jnp.float32)
 
 
+def quantize_kv_rows(x):
+    """Per-row symmetric int8 quant for KV rows: abs-max over the last
+    (head_dim) axis. Returns ``(q, scale)`` with ``q`` int8 shaped like
+    ``x`` and ``scale`` float32 shaped ``x.shape[:-1]``.
+
+    The scale of a row depends ONLY on that row's own values, so the
+    quantized pool content is identical no matter how a sequence is
+    decomposed into prefill chunks / decode quanta / spec rounds — the
+    invariant that keeps shared-prefix aliasing and the COW-vs-unshared
+    bit-stability tests exact on int8 pools. Raw jnp (not a Tensor op):
+    both the serving quantum and ``block_multihead_attention`` call it
+    inside already-traced function bodies."""
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -128, 127).astype(jnp.int8)
+    return q, scale
+
+
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", name=None):
     """y = x @ dequant(weight) + bias — weight stays int8 in HBM; the
@@ -121,11 +142,14 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         args.append(ensure_tensor(bias))
 
     def fn(xv, wq, ws, *maybe_b):
-        # per-out-channel scale commutes with the contraction: scale the
-        # OUTPUT, so the weight feeds the matmul straight from its int8
-        # HBM residency (no dequantized bf16 weight copy); plain
-        # broadcast keeps 1-D inputs returning 1-D outputs
-        y = (xv @ wq.astype(xv.dtype)) * ws.astype(xv.dtype)
+        # dequantize INTO the matmul: the weight's HBM residency stays
+        # int8 and XLA fuses the convert+scale into the MXU feed. The
+        # per-element dequant multiply is IEEE-exact, so a float model
+        # holding ``wq.astype(f32) * ws`` computes BIT-IDENTICAL logits
+        # — the parity oracle the quantized serving engine is tested
+        # against (scaling the output instead would reassociate the
+        # contraction and lose that exactness).
+        y = xv @ (wq.astype(xv.dtype) * ws.astype(xv.dtype))
         if maybe_b:
             y = y + maybe_b[0]
         return y
@@ -214,3 +238,143 @@ class QuantizedLinear(Layer):
         return weight_only_linear(
             x, self.quant_weight, self.bias, self.weight_scale
         )
+
+
+class QuantizedColumnParallelLinear(QuantizedLinear):
+    """Weight-only int8 ColumnParallelLinear: ``quant_weight`` shards
+    (None, "mp") exactly like the float layer's weight, and the
+    per-OUT-channel ``weight_scale`` rides the same split as ("mp",) —
+    each shard dequantizes its own channels locally, so TP composes
+    with no extra collectives (GSPMD sees the identical logical
+    program)."""
+
+    def __init__(self, in_features, out_features, has_bias=True,
+                 gather_output=True):
+        super().__init__(in_features, out_features, has_bias=has_bias)
+        self._gather_output = gather_output
+        from ...parallel import mesh as mesh_state
+
+        self.quant_weight.is_distributed = True
+        self.quant_weight._value = mesh_state.shard_value(
+            self.quant_weight._value, None, "mp")
+        self.weight_scale.is_distributed = True
+        self.weight_scale._value = mesh_state.shard_value(
+            self.weight_scale._value, "mp")
+        if self.bias is not None:
+            self.bias.is_distributed = True
+            self.bias._value = mesh_state.shard_value(
+                self.bias._value, "mp")
+
+    @staticmethod
+    def from_parallel(layer):
+        qw, scale = weight_quantize(layer.weight)
+        out = QuantizedColumnParallelLinear(
+            layer.weight.shape[0], layer.weight.shape[1],
+            has_bias=layer.bias is not None,
+            gather_output=layer._gather_output,
+        )
+        out.quant_weight.set_value(qw)
+        out.weight_scale.set_value(scale)
+        if layer.bias is not None:
+            out.bias.set_value(layer.bias)
+        return out
+
+    def forward(self, x):
+        from ...parallel import mesh as mesh_state
+
+        out = weight_only_linear(
+            x, self.quant_weight, self.bias, self.weight_scale)
+
+        def mark(v):
+            spec = [None] * (v.ndim - 1)
+            if self._gather_output:
+                return mesh_state.constraint(v, *spec, None)
+            return mesh_state.constraint(v, *spec, "mp")
+
+        return apply(mark, out, op_name="column_parallel_out")
+
+
+class QuantizedRowParallelLinear(QuantizedLinear):
+    """Weight-only int8 RowParallelLinear: ``quant_weight`` shards
+    ("mp", None); the per-out-channel scale multiplies whole columns,
+    which the input-dim split leaves intact, so ``weight_scale`` (and
+    any bias) stay replicated and GSPMD inserts the same forward
+    all-reduce as the float layer."""
+
+    def __init__(self, in_features, out_features, has_bias=True,
+                 input_is_parallel=False):
+        super().__init__(in_features, out_features, has_bias=has_bias)
+        self._input_is_parallel = input_is_parallel
+        from ...parallel import mesh as mesh_state
+
+        self.quant_weight.is_distributed = True
+        self.quant_weight._value = mesh_state.shard_value(
+            self.quant_weight._value, "mp", None)
+
+    @staticmethod
+    def from_parallel(layer):
+        qw, scale = weight_quantize(layer.weight)
+        out = QuantizedRowParallelLinear(
+            layer.weight.shape[0], layer.weight.shape[1],
+            has_bias=layer.bias is not None,
+            input_is_parallel=layer._input_is_parallel,
+        )
+        out.quant_weight.set_value(qw)
+        out.weight_scale.set_value(scale)
+        if layer.bias is not None:
+            out.bias.set_value(layer.bias)
+        return out
+
+    def forward(self, x):
+        from ...parallel import mesh as mesh_state
+
+        x = ensure_tensor(x)
+        if self._input_is_parallel:
+            def mark_in(v):
+                spec = [None] * (v.ndim - 1)
+                return mesh_state.constraint(v, *spec, "mp")
+
+            x = apply(mark_in, x, op_name="row_parallel_in")
+        out = weight_only_linear(
+            x, self.quant_weight, self.bias, self.weight_scale)
+
+        def mark_out(v):
+            spec = [None] * v.ndim
+            return mesh_state.constraint(v, *spec)
+
+        return apply(mark_out, out, op_name="row_parallel_out")
+
+
+def quantize_for_serving(model, algo="weight_only_int8"):
+    """In-place ``QuantizedLinear.from_linear`` sweep over a model: every
+    Linear / ColumnParallelLinear / RowParallelLinear becomes its
+    weight-only int8 counterpart (q/k/v/o projections, MLP linears,
+    lm_head); embeddings and norms stay float. TP-composable: parallel
+    layers convert to the Quantized*ParallelLinear variants whose scales
+    shard with their layer's mp split. ``llm.int8`` maps to the same
+    per-out-channel int8 kernel on TPU (the outlier decomposition is a
+    CUDA-mixed-precision workaround the MXU path does not need)."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported serving quantize algo: {algo}")
+    from ..layer.common import Linear
+    from ...distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear,
+    )
+
+    def walk(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, ColumnParallelLinear):
+                layer._sub_layers[name] = \
+                    QuantizedColumnParallelLinear.from_parallel(sub)
+            elif isinstance(sub, RowParallelLinear):
+                layer._sub_layers[name] = \
+                    QuantizedRowParallelLinear.from_parallel(sub)
+            elif isinstance(sub, Linear):
+                layer._sub_layers[name] = QuantizedLinear.from_linear(sub)
+            elif isinstance(sub, QuantizedLinear):
+                pass  # already converted (idempotent sweep)
+            else:
+                walk(sub)
+
+    walk(model)
+    return model

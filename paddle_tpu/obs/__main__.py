@@ -43,7 +43,11 @@ must flush the flight journals, and the dashboard must render the
 overload line), and the PREFIX-CACHE smoke (ISSUE 9: a forced cache
 hit + copy-on-write must fire the prefix counters, keep the streams
 bit-identical to an unshared engine, and render the dashboard's
-prefix line), and the ATTRIBUTION smoke (ISSUE 10: the cost ledger
+prefix line), the QUANTIZED-SERVING smoke (ISSUE 14: a forced hit +
+COW on a weight-int8/kv-int8 engine must keep shared streams
+bit-identical to an unshared int8 engine and show the dtype-aware
+pool-bytes gauge well under half a float engine's), and the
+ATTRIBUTION smoke (ISSUE 10: the cost ledger
 must conserve — phase token buckets sum to the emitted-token counter
 token-for-token, and per-phase seconds sum to the measured quantum
 walls within float tolerance), and the RESILIENCE smoke (ISSUE 13: a
@@ -245,7 +249,7 @@ def _cmd_watch(args):
 
 _CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step",
                   "serving_frontdoor_step", "serving_prefix_step",
-                  "serving_tp_step")
+                  "serving_int8_step", "serving_tp_step")
 
 _REEXEC_GUARD = "_PADDLE_TPU_OBS_REEXEC"
 
@@ -445,6 +449,73 @@ def _check_prefix_smoke():
           f"to the unshared engine")
 
 
+def _check_int8_smoke():
+    """The quantized-serving smoke (ISSUE 14): force a prefix-cache
+    hit and a copy-on-write on an int8 engine (weight-only int8 +
+    int8 KV with per-row scale pools) and assert sharing composes
+    with quantization — the shared streams stay bit-identical to an
+    UNSHARED int8 engine's, the hit/COW counters fire on the
+    quantized pool, and the dtype-aware ``serving_pool_bytes`` gauge
+    shows the int8 pool pinning well under half the bytes of a float
+    engine holding the same blocks."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, cfg.vocab_size, 8).astype(np.int32)
+
+    def drive(prefix, quant):
+        # a fresh model per engine: the quantize sweep rewrites the
+        # Linear layers in place, so engines must not share one
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        kw = (dict(quantize="weight_only_int8", kv_dtype="int8")
+              if quant else {})
+        engine = ServingEngine(model, num_slots=2, block_size=4,
+                               prefill_chunk=8, decode_quantum=2,
+                               prefix_cache=prefix, **kw)
+        first = engine.submit(prompt.copy(), max_new_tokens=4)
+        engine.step()  # prefill + publish before the twin arrives
+        mid_bytes = engine.pool.bytes_in_use()
+        second = engine.submit(prompt.copy(), max_new_tokens=4)
+        engine.run()
+        return engine, first, second, mid_bytes
+
+    shared, s1, s2, q_bytes = drive(True, True)
+    plain, p1, p2, _ = drive(False, True)
+    flt, _, _, f_bytes = drive(True, False)
+    if (s1.tokens, s2.tokens) != (p1.tokens, p2.tokens):
+        raise AssertionError(
+            f"int8 prefix-shared streams diverged from the unshared "
+            f"int8 engine: {s1.tokens}/{s2.tokens} vs "
+            f"{p1.tokens}/{p2.tokens}")
+    pool = shared.pool
+    if not pool.quantized:
+        raise AssertionError("kv_dtype='int8' engine built a float "
+                             "pool")
+    if pool.prefix_hits < 2 or pool.cow_copies < 1:
+        raise AssertionError(
+            f"forced hit/COW did not fire on the int8 pool: "
+            f"hits={pool.prefix_hits} cow={pool.cow_copies}")
+    if not q_bytes or q_bytes > 0.5 * f_bytes:
+        raise AssertionError(
+            f"int8 pool residency win missing: {q_bytes} B vs float "
+            f"{f_bytes} B for the same allocated blocks")
+    g = shared.obs.registry.get("serving_pool_bytes")
+    if g.value(pool="target", kv_dtype="int8") <= 0:
+        raise AssertionError(
+            "serving_pool_bytes{kv_dtype=int8} gauge never fired "
+            "(the prefix index holds cached blocks, so the final "
+            "step's residency must be non-zero)")
+    print(f"int8 smoke: hits={pool.prefix_hits} "
+          f"cow={pool.cow_copies}, shared streams bit-identical to "
+          f"the unshared int8 engine, pool bytes {q_bytes} vs float "
+          f"{f_bytes} ({f_bytes / q_bytes:.2f}x residency win)")
+
+
 def _check_attribution_smoke():
     """The cost-ledger smoke (ISSUE 10): drive the demo engine through
     its speculative arm and assert the ledger is CONSERVATIVE — every
@@ -588,6 +659,11 @@ def _cmd_check(args):
     except (AssertionError, ValueError) as e:
         failed = True
         print(f"prefix smoke: FAIL — {e}", file=sys.stderr)
+    try:
+        _check_int8_smoke()
+    except (AssertionError, ValueError) as e:
+        failed = True
+        print(f"int8 smoke: FAIL — {e}", file=sys.stderr)
     try:
         _check_attribution_smoke()
     except (AssertionError, ValueError, KeyError) as e:

@@ -357,6 +357,16 @@ def render_dashboard(snapshot, report=None, width=62):
             lines.append(
                 f" pool[{pool:<6}] blocks {in_use:>6.0f} in use, "
                 f"{free:>6.0f} free, util {util:6.1%}")
+        for kvd in ("float32", "bfloat16", "float16", "int8"):
+            b = g("serving_pool_bytes", pool=pool, kv_dtype=kvd)
+            if b:
+                chip = g("serving_pool_per_chip_bytes", pool=pool,
+                         kv_dtype=kvd)
+                per_chip = (f", {chip / 1024.0:8.1f} KiB/chip"
+                            if chip and chip != b else "")
+                lines.append(
+                    f" bytes[{pool:<5}] {b / 1024.0:>8.1f} KiB resident "
+                    f"(kv {kvd}{per_chip})")
         hits = g("serving_prefix_cache_hits_total", pool=pool)
         misses = g("serving_prefix_cache_misses_total", pool=pool)
         if hits or misses:

@@ -44,6 +44,10 @@ Exported serving metrics (all host-boundary):
   throughput), ``serving_spec_acceptance_rate`` (per-round),
   ``serving_slots_occupied``, ``serving_pool_{blocks_in_use,
   free_blocks,utilization}{pool=target|draft}``,
+  ``serving_pool_{bytes,per_chip_bytes}{pool=...,kv_dtype=float|int8}``
+  (dtype-aware residency: actual itemsize x elements + the int8
+  pools' f32 scale rows — the gauge a quantized engine's ~2x
+  capacity win shows up on),
   ``serving_prefix_cache_cached_block_fraction{pool=target|draft}``
   (index-held blocks over blocks in use), and the TP census pair
   ``serving_collective_{bytes,count}_total`` (unlabeled totals plus a
@@ -196,6 +200,17 @@ class ServingObs:
         self._g_util = r.gauge(
             "serving_pool_utilization",
             "live tokens / allocated token capacity")
+        # dtype-aware residency: actual bytes the allocated blocks pin
+        # (pool itemsize x elements + the int8 pools' f32 scale rows),
+        # labeled by the pool's kv dtype so a dashboard shows the int8
+        # residency win directly against a float engine's line
+        self._g_bytes = r.gauge(
+            "serving_pool_bytes",
+            "bytes pinned by allocated KV blocks (incl. scale pools), "
+            "by pool and kv_dtype")
+        self._g_chip_bytes = r.gauge(
+            "serving_pool_per_chip_bytes",
+            "per-chip bytes pinned by allocated KV blocks under TP")
         self._c_shed = r.counter(
             "serving_requests_shed_total",
             "requests refused by load shedding")
@@ -472,6 +487,12 @@ class ServingObs:
             self._g_blocks.set(st["blocks_in_use"], pool=label)
             self._g_free.set(st["free_blocks"], pool=label)
             self._g_util.set(st["utilization"], pool=label)
+            kv_dtype = st.get("kv_dtype", "float")
+            self._g_bytes.set(float(st.get("bytes_in_use", 0)),
+                              pool=label, kv_dtype=kv_dtype)
+            self._g_chip_bytes.set(
+                float(st.get("per_chip_bytes_in_use", 0)),
+                pool=label, kv_dtype=kv_dtype)
             if getattr(p, "prefix_cache_enabled", False):
                 self._sync_prefix(label, p, st)
         if self.tracer is not None:

@@ -101,6 +101,7 @@ from ..core import autograd
 from ..jit import functional_call
 from ..nlp.generation import _filter_logits
 from ..nlp.paged_cache import PagedKVCachePool
+from ..nn.quant import quantize_for_serving, quantize_kv_rows
 from ..obs.flight import FlightRecorder
 from ..obs.serving import ServingObs
 from ..obs.slo import SLOSet
@@ -176,6 +177,8 @@ def _tp_shard_params(model):
     means the model has no tensor-parallel structure)."""
     from ..distributed.fleet.layers.mpu.mp_layers import (
         ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    from ..nn.quant import (
+        QuantizedColumnParallelLinear, QuantizedRowParallelLinear)
 
     placed = set()
 
@@ -185,7 +188,21 @@ def _tp_shard_params(model):
 
     n_sharded = 0
     for _, layer in model.named_sublayers(include_self=True):
-        if isinstance(layer, ColumnParallelLinear):
+        if isinstance(layer, QuantizedColumnParallelLinear):
+            # int8 weight splits like its float twin; the per-out-channel
+            # scale vector rides the same "mp" split as the out dim.
+            put(layer.quant_weight, None, "mp")
+            put(layer.weight_scale, "mp")
+            n_sharded += 1
+            if layer.bias is not None:
+                put(layer.bias, "mp")
+        elif isinstance(layer, QuantizedRowParallelLinear):
+            put(layer.quant_weight, "mp", None)
+            put(layer.weight_scale)  # out-channel scales: replicated
+            n_sharded += 1
+            if layer.bias is not None:
+                put(layer.bias)  # replicated: added after the all-reduce
+        elif isinstance(layer, ColumnParallelLinear):
             put(layer.weight, None, "mp")
             n_sharded += 1
             if layer.bias is not None:
@@ -219,15 +236,23 @@ def _rope_rows(x, cos, sin):
     return out.astype(x.dtype)
 
 
-def _xla_paged_decode_attn(q, kp, vp, tables, lens):
+def _xla_paged_decode_attn(q, kp, vp, tables, lens, ks=None, vs=None):
     """Off-TPU decode attention over the paged pool: gather the table's
     blocks and run the same f32 masked softmax as the contiguous-cache
-    fallback (`_masked_decode_attn`)."""
+    fallback (`_masked_decode_attn`). ``ks``/``vs`` are the optional
+    per-row scale pools of an int8 pool ((NB, BS, HK) f32): the gathered
+    rows dequantize in f32 before the softmax, so the math matches the
+    float path up to the quantization rounding itself."""
     s_, h, d = q.shape
     w = tables.shape[1]
     bs, hk = kp.shape[1], kp.shape[2]
     k = kp[tables].reshape(s_, w * bs, hk, d)
     v = vp[tables].reshape(s_, w * bs, hk, d)
+    if ks is not None:
+        k = k.astype(jnp.float32) * ks[tables].reshape(
+            s_, w * bs, hk)[..., None]
+        v = v.astype(jnp.float32) * vs[tables].reshape(
+            s_, w * bs, hk)[..., None]
     rep = h // hk
     kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
     vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
@@ -241,7 +266,7 @@ def _xla_paged_decode_attn(q, kp, vp, tables, lens):
     return out.astype(q.dtype)
 
 
-def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens):
+def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens, ks=None, vs=None):
     """Chunked decode attention over the paged pool (the speculative
     VERIFY pass): query position j of each slot attends pool positions
     < base+j+1 — the same gather + f32 masked softmax as
@@ -253,6 +278,11 @@ def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens):
     bs, hk = kp.shape[1], kp.shape[2]
     k = kp[tables].reshape(s_, w * bs, hk, d)
     v = vp[tables].reshape(s_, w * bs, hk, d)
+    if ks is not None:
+        k = k.astype(jnp.float32) * ks[tables].reshape(
+            s_, w * bs, hk)[..., None]
+        v = v.astype(jnp.float32) * vs[tables].reshape(
+            s_, w * bs, hk)[..., None]
     rep = h // hk
     kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
     vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
@@ -267,20 +297,24 @@ def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens):
     return out.astype(q.dtype)
 
 
-def _paged_attn(q, kp, vp, tables, lens):
+def _paged_attn(q, kp, vp, tables, lens, ks=None, vs=None):
     """Route decode attention: Pallas paged kernel on TPU (block tables
     dereferenced in SMEM, one pool block DMA per grid step), XLA gather
-    fallback elsewhere."""
+    fallback elsewhere. Per-row scale pools (int8 engine) always take
+    the XLA path: the Pallas kernel only supports STATIC per-head
+    scales, not per-(block, position, head) pools."""
     from ..core.flags import get_flags
 
-    flags = get_flags(["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
-    use_pallas = flags["FLAGS_use_pallas_kernels"] and (
-        jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
-    if use_pallas:
-        from ..ops.pallas.paged_attention import paged_decode_attention
+    if ks is None:
+        flags = get_flags(
+            ["FLAGS_use_pallas_kernels", "FLAGS_pallas_force"])
+        use_pallas = flags["FLAGS_use_pallas_kernels"] and (
+            jax.default_backend() == "tpu" or flags["FLAGS_pallas_force"])
+        if use_pallas:
+            from ..ops.pallas.paged_attention import paged_decode_attention
 
-        return paged_decode_attention(q, kp, vp, tables, lens)
-    return _xla_paged_decode_attn(q, kp, vp, tables, lens)
+            return paged_decode_attention(q, kp, vp, tables, lens)
+    return _xla_paged_decode_attn(q, kp, vp, tables, lens, ks=ks, vs=vs)
 
 
 def _pin_kv(arr):
@@ -297,13 +331,31 @@ def _pin_kv(arr):
     return arr
 
 
+def _pin_kv_scale(arr):
+    """`_pin_kv` for the (NB, BS, HK) scale pools of an int8 pool: the
+    kv-head axis is the last one, so the constraint drops the trailing
+    head-dim entry. Same identity conditions as `_pin_kv`."""
+    mp = mesh_state.mesh_axis_size("mp")
+    if mp > 1 and arr.shape[2] % mp == 0:
+        return mesh_state.constraint(arr, None, None, "mp")
+    return arr
+
+
 def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
-                      kc, vc, live):
+                      kc, vc, live, ks=(), vs=()):
     """One token for every slot over a paged pool (the quantum's
     per-step body; mirrors generation._manual_decode with block-table
     writes instead of dense-cache slice updates). Parameterized by
     ``model`` so the plain quantum (target) and the speculative DRAFT
-    scan (serving/speculative.py) share one decode-step definition."""
+    scan (serving/speculative.py) share one decode-step definition.
+
+    ``ks``/``vs`` are the per-layer per-row scale pools of an int8
+    pool (empty tuples on a float pool — zero extra avals, so the
+    unquantized quantum graph and its golden are byte-identical): each
+    KV row quantizes symmetrically at its write site and the gathered
+    context dequantizes inside the attention math. Returns
+    ``(logits, new_kc, new_vc, new_ks, new_vs)``; the scale tuples stay
+    ``()`` when unquantized."""
     cfg = model.config
     core = model.llama
     s = ids_t.shape[0]
@@ -326,7 +378,8 @@ def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
     write_off = jnp.where(live, seq_lens % bs, 0)
     lens = jnp.where(live, seq_lens + 1, 1)
 
-    new_kc, new_vc = [], []
+    quant = len(ks) > 0
+    new_kc, new_vc, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(core.layers):
         attn = layer.self_attn
         residual = hidden
@@ -336,24 +389,36 @@ def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
         v = attn.v_proj(x).reshape([s, 1, hk, d])
         qv = _rope_rows(q._value[:, 0], cos, sin)    # (S, H, D)
         kv = _rope_rows(k._value[:, 0], cos, sin)
+        vv = v._value[:, 0]
+        ksi = vsi = None
+        if quant:
+            kv, k_sc = quantize_kv_rows(kv)          # (S, HK, D)/(S, HK)
+            vv, v_sc = quantize_kv_rows(vv)
+            ksi = _pin_kv_scale(
+                ks[i].at[write_blk, write_off].set(k_sc))
+            vsi = _pin_kv_scale(
+                vs[i].at[write_blk, write_off].set(v_sc))
+            new_ks.append(ksi)
+            new_vs.append(vsi)
         kci = _pin_kv(kc[i].at[write_blk, write_off].set(
             kv.astype(kc[i].dtype)))
         vci = _pin_kv(vc[i].at[write_blk, write_off].set(
-            v._value[:, 0].astype(vc[i].dtype)))
+            vv.astype(vc[i].dtype)))
         new_kc.append(kci)
         new_vc.append(vci)
-        att = _paged_attn(qv, kci, vci, tables, lens)
+        att = _paged_attn(qv, kci, vci, tables, lens, ks=ksi, vs=vsi)
         att_t = Tensor(att.reshape(s, 1, h * d), stop_gradient=True)
         hidden = residual + attn.o_proj(att_t)
         hidden = hidden + layer.mlp(
             layer.post_attention_layernorm(hidden))
     hidden = core.norm(hidden)
     logits = model.lm_head(hidden)
-    return logits._value[:, 0], new_kc, new_vc
+    return (logits._value[:, 0], new_kc, new_vc,
+            tuple(new_ks), tuple(new_vs))
 
 
 def paged_chunk_math(model, scratch_block, ids_t, seq_lens, tables,
-                     kc, vc, live):
+                     kc, vc, live, ks=(), vs=()):
     """C-token suffix forward for every slot over a paged pool — the
     speculative round's TARGET verify pass (reference: the speculative
     verify forward of the reference's serving stack — unverified,
@@ -386,7 +451,8 @@ def paged_chunk_math(model, scratch_block, ids_t, seq_lens, tables,
     write_off = jnp.where(live[:, None], wpos % bs, 0)
     base_lens = jnp.where(live, seq_lens, 0)
 
-    new_kc, new_vc = [], []
+    quant = len(ks) > 0
+    new_kc, new_vc, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(core.layers):
         attn = layer.self_attn
         residual = hidden
@@ -396,20 +462,32 @@ def paged_chunk_math(model, scratch_block, ids_t, seq_lens, tables,
         v = attn.v_proj(x).reshape([s, c, hk, d])
         qv = _rope_rows(q._value, cos, sin)          # (S, C, H, D)
         kv = _rope_rows(k._value, cos, sin)
+        vv = v._value
+        ksi = vsi = None
+        if quant:
+            kv, k_sc = quantize_kv_rows(kv)      # (S,C,HK,D)/(S,C,HK)
+            vv, v_sc = quantize_kv_rows(vv)
+            ksi = _pin_kv_scale(
+                ks[i].at[write_blk, write_off].set(k_sc))
+            vsi = _pin_kv_scale(
+                vs[i].at[write_blk, write_off].set(v_sc))
+            new_ks.append(ksi)
+            new_vs.append(vsi)
         kci = _pin_kv(kc[i].at[write_blk, write_off].set(
             kv.astype(kc[i].dtype)))
         vci = _pin_kv(vc[i].at[write_blk, write_off].set(
-            v._value.astype(vc[i].dtype)))
+            vv.astype(vc[i].dtype)))
         new_kc.append(kci)
         new_vc.append(vci)
-        att = _xla_paged_chunk_attn(qv, kci, vci, tables, base_lens)
+        att = _xla_paged_chunk_attn(qv, kci, vci, tables, base_lens,
+                                    ks=ksi, vs=vsi)
         att_t = Tensor(att.reshape(s, c, h * d), stop_gradient=True)
         hidden = residual + attn.o_proj(att_t)
         hidden = hidden + layer.mlp(
             layer.post_attention_layernorm(hidden))
     hidden = core.norm(hidden)
     logits = model.lm_head(hidden)
-    return logits._value, new_kc, new_vc
+    return logits._value, new_kc, new_vc, tuple(new_ks), tuple(new_vs)
 
 
 class _AuditedStep:
@@ -564,6 +642,30 @@ class ServingEngine:
             accounting drift rebuilds the allocator from the live
             block tables. Default ``None``: fail-stop exactly as
             before.
+        quantize: ``"weight_only_int8"`` (or ``"llm.int8"``) sweeps the
+            target — and draft — stacks through
+            :func:`~paddle_tpu.nn.quant.quantize_for_serving` at build,
+            BEFORE AOT lowering: every quantum arm's executable carries
+            int8 weights + per-out-channel scales, and the dequant
+            multiply fuses into each matmul (weights stay int8 in HBM).
+            The per-element dequant is IEEE-exact, so greedy streams are
+            BIT-IDENTICAL to a float engine holding the dequantized
+            weights — the parity oracle the tests pin. TP-composable:
+            quantized mp layers shard their scales with the layer's
+            split. Default ``None``: float weights, graphs untouched.
+        kv_dtype: ``"int8"`` builds both paged pools quantized: int8
+            block buffers plus per-row f32 scale pools ((NB, BS, HK),
+            one scale per written row), symmetric abs-max quant at
+            every KV-write site IN-GRAPH and dequant inside the
+            attention gather — still one dispatch, all four pool
+            pytrees donated. A row's scale depends only on its own
+            values, so prefix sharing, COW (scale rows copy with the
+            block), LRU eviction, preemption, and snapshot/restore work
+            unchanged, and shared-vs-unshared streams stay
+            bit-identical. Halves KV residency (int8 + d-wide scale vs
+            2-byte floats). Default ``None``: float pools, every
+            existing golden byte-identical (the scale tuples are empty
+            pytrees — zero extra avals in the quantum signature).
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
@@ -573,7 +675,8 @@ class ServingEngine:
                  spec_gamma=4, prefix_cache=False,
                  per_request_sampling=False, obs=None,
                  trace=False, slo=None, flight=None, mesh=None, tp=None,
-                 faults=None, resilience=None):
+                 faults=None, resilience=None, quantize=None,
+                 kv_dtype=None):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -615,7 +718,18 @@ class ServingEngine:
             if int(spec_gamma) < 1:
                 raise ValueError(
                     f"spec_gamma must be >= 1, got {spec_gamma}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unsupported kv_dtype {kv_dtype!r} (None or 'int8')")
+        self.quantize = quantize
+        self.kv_dtype = kv_dtype
         self.model = model
+        if quantize is not None:
+            # sweep BEFORE .eval()/tp-shard/_p_vals snapshot: the
+            # quantized params must be what every arm lowers against
+            quantize_for_serving(model, algo=quantize)
+            if spec_draft is not None:
+                quantize_for_serving(spec_draft, algo=quantize)
         model.eval()
         self.spec_draft = spec_draft
         self.spec_gamma = int(spec_gamma)
@@ -640,7 +754,12 @@ class ServingEngine:
                         "parallel layers) — this model has no mp layers "
                         "to shard")
         self._p_vals = [p._value for _, p in model.named_parameters()]
-        cache_dtype = self._p_vals[0].dtype
+        # the model dtype the float pools inherit: first FLOATING param
+        # (a quantized stack's first param may be an int8 weight)
+        cache_dtype = next(
+            (v.dtype for v in self._p_vals
+             if jnp.issubdtype(v.dtype, jnp.floating)),
+            self._p_vals[0].dtype)
         s = self.config.num_slots
         bs = int(block_size)
         # the speculative verify writes up to gamma slots past the
@@ -654,7 +773,8 @@ class ServingEngine:
         self.pool = PagedKVCachePool(
             num_blocks, bs, cfg.num_key_value_heads, cfg.head_dim,
             num_layers=cfg.num_hidden_layers, dtype=cache_dtype,
-            prefix_cache=self.prefix_cache, mesh=self.mesh)
+            prefix_cache=self.prefix_cache, mesh=self.mesh,
+            kv_dtype=kv_dtype)
         # masked (retired/empty) rows dump their KV writes here
         self._scratch_block = self.pool.ensure("__scratch__", 1)[0]
         self.d_pool = None
@@ -670,11 +790,18 @@ class ServingEngine:
             self._d_p_vals = [p._value
                               for _, p in spec_draft.named_parameters()]
             d_cfg = spec_draft.config
+            d_cache_dtype = next(
+                (v.dtype for v in self._d_p_vals
+                 if jnp.issubdtype(v.dtype, jnp.floating)),
+                self._d_p_vals[0].dtype)
+            # the draft pool quantizes too: spec decoding doubles pool
+            # pressure, so the residency win must cover both pools
             self.d_pool = PagedKVCachePool(
                 num_blocks, bs, d_cfg.num_key_value_heads,
                 d_cfg.head_dim, num_layers=d_cfg.num_hidden_layers,
-                dtype=self._d_p_vals[0].dtype,
-                prefix_cache=self.prefix_cache, mesh=self.mesh)
+                dtype=d_cache_dtype,
+                prefix_cache=self.prefix_cache, mesh=self.mesh,
+                kv_dtype=kv_dtype)
             self._d_scratch_block = self.d_pool.ensure("__scratch__",
                                                        1)[0]
         self.scheduler = Scheduler(
@@ -717,18 +844,25 @@ class ServingEngine:
                 base=d_cfg.rope_theta)
             self._d_rotary = Tensor(jnp.stack([d_cos, d_sin]),
                                     stop_gradient=True)
-            self._quantum = jax.jit(make_spec_round(self),
-                                    donate_argnums=(0, 1, 2, 3))
+            # argnums 0..7 = target kc/vc/ks/vs + draft kc/vc/ks/vs; on
+            # a float engine the scale tuples are EMPTY pytrees, so
+            # donating them is a no-op and the flat donated set — and
+            # every existing golden — is unchanged
+            self._quantum = jax.jit(
+                make_spec_round(self),
+                donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
             self._audited = _AuditedStep(
                 self._quantum,
-                n_donatable=2 * (cfg.num_hidden_layers
-                                 + d_cfg.num_hidden_layers),
+                n_donatable=(4 if self.pool.quantized else 2)
+                * (cfg.num_hidden_layers + d_cfg.num_hidden_layers),
                 name="speculative_verify_step", mesh=self.mesh)
         else:
             self._quantum = jax.jit(self._make_quantum(),
-                                    donate_argnums=(0, 1))
+                                    donate_argnums=(0, 1, 2, 3))
             self._audited = _AuditedStep(
-                self._quantum, n_donatable=2 * cfg.num_hidden_layers,
+                self._quantum,
+                n_donatable=(4 if self.pool.quantized else 2)
+                * cfg.num_hidden_layers,
                 mesh=self.mesh)
         # under tp the small per-slot state rides every dispatch
         # committed replicated, so the compiled quantum's input layouts
@@ -793,10 +927,14 @@ class ServingEngine:
         n_params = sum(int(v.size) for v in self._p_vals)
         embed = (int(getattr(cfg, "vocab_size", 0))
                  * int(getattr(cfg, "hidden_size", 0)))
+        # int8 flops model: a quantized stack feeds the MXU's int8 path,
+        # whose peak is 2x the bf16 peak — the MFU denominator doubles
+        # (flops per token is unchanged: same 2N contraction count)
         self.obs.ledger.configure(
             flops_per_token=decode_flops_per_token(
                 n_params, n_embedding_params=embed),
-            peak_flops=peak_flops_per_chip())
+            peak_flops=peak_flops_per_chip()
+            * (2.0 if quantize is not None else 1.0))
         # SLO + flight recorder (the operability tier over the obs
         # boundaries): health feeds the front door's shedding policy
         # (serving/frontend.py), and the journal explains a slow tail
@@ -1228,9 +1366,11 @@ class ServingEngine:
         self._spec_disabled = True
         cfg = self.model.config
         self._plain_quantum = jax.jit(self._make_quantum(),
-                                      donate_argnums=(0, 1))
+                                      donate_argnums=(0, 1, 2, 3))
         self._plain_audited = _AuditedStep(
-            self._plain_quantum, n_donatable=2 * cfg.num_hidden_layers,
+            self._plain_quantum,
+            n_donatable=(4 if self.pool.quantized else 2)
+            * cfg.num_hidden_layers,
             mesh=self.mesh)
         now = self._now()
         self.obs.on_degrade("spec_disabled", now)
@@ -1333,6 +1473,8 @@ class ServingEngine:
             "spec_gamma": self.spec_gamma,
             "prefix_cache": self.prefix_cache,
             "per_request_sampling": self._per_request_sampling,
+            "quantize": self.quantize,
+            "kv_dtype": self.kv_dtype,
             "submitted_total": self.scheduler._submitted_total,
             "inflight": [req_state(r) for r in inflight],
             "completed": [{"req_id": str(r.req_id),
@@ -1365,7 +1507,9 @@ class ServingEngine:
             eos_token_id=snap["eos_token_id"],
             spec_gamma=snap["spec_gamma"],
             prefix_cache=snap["prefix_cache"],
-            per_request_sampling=snap["per_request_sampling"])
+            per_request_sampling=snap["per_request_sampling"],
+            quantize=snap.get("quantize"),
+            kv_dtype=snap.get("kv_dtype"))
         kwargs.update(overrides)
         eng = cls(model, spec_draft=spec_draft, **kwargs)
         now = eng._now()
@@ -1462,6 +1606,15 @@ class ServingEngine:
                 for i in range(cfg.num_hidden_layers)]
         vc_t = [Tensor(pool.v_pools[i], stop_gradient=True)
                 for i in range(cfg.num_hidden_layers)]
+        ks_t = vs_t = None
+        if pool.quantized:
+            # int8 pool: thread the per-row scale pools through the
+            # fused op; each written row quantizes in-graph and the
+            # mutated scale pools come back as the new truth
+            ks_t = [Tensor(pool.k_scales[i], stop_gradient=True)
+                    for i in range(cfg.num_hidden_layers)]
+            vs_t = [Tensor(pool.v_scales[i], stop_gradient=True)
+                    for i in range(cfg.num_hidden_layers)]
         common = dict(
             seq_lens_encoder=paddle.to_tensor(
                 np.asarray(enc_lens, np.int32)),
@@ -1491,8 +1644,11 @@ class ServingEngine:
                 v = attn.v_proj(x)
                 qkv = paddle.concat([q, k, v], axis=-1) \
                     .reshape([total, (h + 2 * hk) * d])
+                scales = ({} if ks_t is None else
+                          dict(cache_k_scale_pool=ks_t[i],
+                               cache_v_scale_pool=vs_t[i]))
                 att = block_multihead_attention(
-                    qkv, kc_t[i], vc_t[i], **common)
+                    qkv, kc_t[i], vc_t[i], **common, **scales)
                 att3 = att.reshape([1, total, h * d])
                 hidden = residual + attn.o_proj(att3)
                 hidden = hidden + layer.mlp(
@@ -1504,6 +1660,9 @@ class ServingEngine:
         for i in range(cfg.num_hidden_layers):
             pool.k_pools[i] = pool._pin(kc_t[i]._value)
             pool.v_pools[i] = pool._pin(vc_t[i]._value)
+            if ks_t is not None:
+                pool.k_scales[i] = pool._pin_scale(ks_t[i]._value)
+                pool.v_scales[i] = pool._pin_scale(vs_t[i]._value)
         return hidden
 
     def _mixed_step(self):
@@ -1722,18 +1881,21 @@ class ServingEngine:
         has_eos = self.eos_token_id is not None
         eos = -1 if self.eos_token_id is None else int(self.eos_token_id)
 
-        def scan_steps(kc, vc, p_vals, tables, seq_lens, last_tok,
-                       n_gen, done, max_new, keys, temps):
+        def scan_steps(kc, vc, ks, vs, p_vals, tables, seq_lens,
+                       last_tok, n_gen, done, max_new, keys, temps):
+            # ks/vs are the int8 pool's per-row scale pools; on a float
+            # engine they are EMPTY tuples — zero avals in the carry,
+            # so the compiled graph (and golden) is byte-identical
             def body(carry, _):
-                kc, vc, seq_lens, last_tok, n_gen, done = carry
+                kc, vc, ks, vs, seq_lens, last_tok, n_gen, done = carry
                 live = ~done
                 with autograd.no_grad():
                     def fwd(tok_t):
                         return paged_decode_math(
                             model, scratch, tok_t, seq_lens, tables,
-                            kc, vc, live)
+                            kc, vc, live, ks=ks, vs=vs)
 
-                    (logits, kc2, vc2), _ = functional_call(
+                    (logits, kc2, vc2, ks2, vs2), _ = functional_call(
                         model, fwd,
                         [Tensor(last_tok[:, None], stop_gradient=True)],
                         {}, p_vals, [])
@@ -1744,30 +1906,34 @@ class ServingEngine:
                 if has_eos:
                     done2 = done2 | (live & (nxt == eos))
                 seq_lens2 = seq_lens + live.astype(jnp.int32)
-                return (kc2, vc2, seq_lens2, nxt, n_gen2, done2), nxt
+                return (kc2, vc2, ks2, vs2, seq_lens2, nxt, n_gen2,
+                        done2), nxt
 
-            (kc, vc, seq_lens, last_tok, n_gen, done), toks = \
+            (kc, vc, ks, vs, seq_lens, last_tok, n_gen, done), toks = \
                 jax.lax.scan(
-                    body, (kc, vc, seq_lens, last_tok, n_gen, done),
+                    body,
+                    (kc, vc, tuple(ks), tuple(vs), seq_lens, last_tok,
+                     n_gen, done),
                     None, length=t_steps)
-            return kc, vc, seq_lens, last_tok, n_gen, done, toks
+            return (kc, vc, ks, vs, seq_lens, last_tok, n_gen, done,
+                    toks)
 
         if self._per_request_sampling:
             # the front-door variant: per-slot temperature rides the
             # existing per-slot state as ONE extra (S,) f32 input —
             # its own recipe (serving_frontdoor_step) and golden pin
             # this signature; the default quantum below is untouched
-            def quantum(kc, vc, p_vals, tables, seq_lens, last_tok,
-                        n_gen, done, max_new, keys, temps):
-                return scan_steps(kc, vc, p_vals, tables, seq_lens,
-                                  last_tok, n_gen, done, max_new, keys,
-                                  temps)
+            def quantum(kc, vc, ks, vs, p_vals, tables, seq_lens,
+                        last_tok, n_gen, done, max_new, keys, temps):
+                return scan_steps(kc, vc, ks, vs, p_vals, tables,
+                                  seq_lens, last_tok, n_gen, done,
+                                  max_new, keys, temps)
         else:
-            def quantum(kc, vc, p_vals, tables, seq_lens, last_tok,
-                        n_gen, done, max_new, keys):
-                return scan_steps(kc, vc, p_vals, tables, seq_lens,
-                                  last_tok, n_gen, done, max_new, keys,
-                                  None)
+            def quantum(kc, vc, ks, vs, p_vals, tables, seq_lens,
+                        last_tok, n_gen, done, max_new, keys):
+                return scan_steps(kc, vc, ks, vs, p_vals, tables,
+                                  seq_lens, last_tok, n_gen, done,
+                                  max_new, keys, None)
 
         return quantum
 
@@ -1782,10 +1948,17 @@ class ServingEngine:
         return jax.device_put(v, self._rep_sharding)
 
     def _quantum_args(self):
+        # the scale tuples ride right after their pool's v_pools (empty
+        # on a float engine — no avals, goldens untouched); donation
+        # covers all leading pool pytrees
         if self.spec_draft is not None and not self._spec_disabled:
             return (list(self.pool.k_pools), list(self.pool.v_pools),
+                    tuple(self.pool.k_scales),
+                    tuple(self.pool.v_scales),
                     list(self.d_pool.k_pools),
                     list(self.d_pool.v_pools),
+                    tuple(self.d_pool.k_scales),
+                    tuple(self.d_pool.v_scales),
                     self._p_vals, self._d_p_vals,
                     self._dev(self._tables),
                     self._dev(self._d_tables),
@@ -1795,6 +1968,7 @@ class ServingEngine:
                     self._dev(self._max_new),
                     self._dev(self._keys))
         args = (list(self.pool.k_pools), list(self.pool.v_pools),
+                tuple(self.pool.k_scales), tuple(self.pool.v_scales),
                 self._p_vals, self._dev(self._tables),
                 self._dev(self._seq_lens),
                 self._dev(self._last_tok), self._dev(self._n_gen),
@@ -1861,9 +2035,9 @@ class ServingEngine:
                         [req.req_id], pad_to=self._table_width)
                     tables[slot] = np.asarray(row)[0][
                         :self._table_width]
-            (t_kc, t_vc, d_kc, d_vc, seq_lens, last_tok, n_gen, done,
-             stream, counts, acc) = self._guarded_dispatch(
-                 "spec_round", rows)
+            (t_kc, t_vc, t_ks, t_vs, d_kc, d_vc, d_ks, d_vs, seq_lens,
+             last_tok, n_gen, done, stream, counts,
+             acc) = self._guarded_dispatch("spec_round", rows)
         except BaseException:
             for r in excluded:
                 self._done[r.slot] = r.finished
@@ -1872,6 +2046,11 @@ class ServingEngine:
         self.pool.v_pools = list(t_vc)
         self.d_pool.k_pools = list(d_kc)
         self.d_pool.v_pools = list(d_vc)
+        if self.pool.quantized:
+            self.pool.k_scales = list(t_ks)
+            self.pool.v_scales = list(t_vs)
+            self.d_pool.k_scales = list(d_ks)
+            self.d_pool.v_scales = list(d_vs)
         stream = np.asarray(stream)                      # (S, γ+1) sync
         counts = np.asarray(counts)
         acc = np.asarray(acc)
@@ -1944,7 +2123,7 @@ class ServingEngine:
                     [req.req_id], pad_to=self._table_width)
                 self._tables[slot] = np.asarray(row)[0][
                     :self._table_width]
-            kc, vc, seq_lens, last_tok, n_gen, done, toks = \
+            kc, vc, ks, vs, seq_lens, last_tok, n_gen, done, toks = \
                 self._guarded_dispatch("decode", rows)
         except BaseException:
             for r in excluded:
@@ -1952,6 +2131,9 @@ class ServingEngine:
             raise
         self.pool.k_pools = list(kc)
         self.pool.v_pools = list(vc)
+        if self.pool.quantized:
+            self.pool.k_scales = list(ks)
+            self.pool.v_scales = list(vs)
         toks = np.asarray(toks)                          # (T, S) sync
         self._seq_lens = np.asarray(seq_lens).copy()
         self._last_tok = np.asarray(last_tok).copy()

@@ -38,8 +38,9 @@ program batched over the serving slot dimension:
   round's writes. eos/max-new retirement masks compose with the
   variable per-round yield exactly like the plain quantum's.
 
-The engine jits this with the draft AND target pool buffers donated
-(``donate_argnums=(0, 1, 2, 3)``); the compiled program is pinned by
+The engine jits this with the draft AND target pool buffers — plus
+their int8 scale pools, empty pytrees on a float engine — donated
+(``donate_argnums=(0, ..., 7)``); the compiled program is pinned by
 the ``speculative_verify_step`` analysis budget (0 involuntary remat,
 0 host syncs, 0 collectives, bf16 stays bf16, both pools donated).
 
@@ -92,11 +93,14 @@ def make_spec_round(engine):
     State contract (mirrors the plain quantum): ``seq_lens`` counts
     tokens IN both caches (identical histories by construction),
     ``last_tok`` is the newest emitted token not yet cached. Returns
-    ``(t_kc, t_vc, d_kc, d_vc, seq_lens, last_tok, n_gen, done,
-    stream, emitted, accepted)`` where ``stream`` is the (S, γ+1)
-    emission matrix, ``emitted`` the per-slot valid prefix length
-    (yield after eos/max-new caps), and ``accepted`` the raw per-slot
-    acceptance count for the serving stats."""
+    ``(t_kc, t_vc, t_ks, t_vs, d_kc, d_vc, d_ks, d_vs, seq_lens,
+    last_tok, n_gen, done, stream, emitted, accepted)`` where
+    ``stream`` is the (S, γ+1) emission matrix, ``emitted`` the
+    per-slot valid prefix length (yield after eos/max-new caps), and
+    ``accepted`` the raw per-slot acceptance count for the serving
+    stats. The ``*_ks``/``*_vs`` pytrees are the int8 pools' per-row
+    scale pools; on a float engine they are EMPTY tuples (zero avals —
+    the compiled round and its golden are byte-identical)."""
     target = engine.model
     draft = engine.spec_draft
     gamma = int(engine.spec_gamma)
@@ -107,22 +111,22 @@ def make_spec_round(engine):
     t_scratch = engine._scratch_block
     d_scratch = engine._d_scratch_block
 
-    def spec_round(t_kc, t_vc, d_kc, d_vc, t_pv, d_pv, t_tables,
-                   d_tables, seq_lens, last_tok, n_gen, done, max_new,
-                   keys):
+    def spec_round(t_kc, t_vc, t_ks, t_vs, d_kc, d_vc, d_ks, d_vs,
+                   t_pv, d_pv, t_tables, d_tables, seq_lens, last_tok,
+                   n_gen, done, max_new, keys):
         live = ~done
         s_ = last_tok.shape[0]
 
         # -- draft: γ+1 single-token steps under one lax.scan ---------
         def draft_body(carry, j):
-            kcs, vcs, cur = carry
+            kcs, vcs, kss, vss, cur = carry
             with autograd.no_grad():
                 def fwd(tok_t):
                     return paged_decode_math(
                         draft, d_scratch, tok_t, seq_lens + j,
-                        d_tables, kcs, vcs, live)
+                        d_tables, kcs, vcs, live, ks=kss, vs=vss)
 
-                (logits, kcs2, vcs2), _ = functional_call(
+                (logits, kcs2, vcs2, kss2, vss2), _ = functional_call(
                     draft, fwd,
                     [Tensor(cur[:, None], stop_gradient=True)], {},
                     d_pv, [])
@@ -136,10 +140,12 @@ def make_spec_round(engine):
                 nxt = jax.vmap(jax.random.categorical)(
                     step_keys, filt).astype(jnp.int32)
                 q = jax.nn.softmax(filt, axis=-1)
-            return (kcs2, vcs2, nxt), (nxt, q)
+            return (kcs2, vcs2, kss2, vss2, nxt), (nxt, q)
 
-        (d_kc, d_vc, _), (props, qs) = jax.lax.scan(
-            draft_body, (d_kc, d_vc, last_tok), jnp.arange(gamma + 1))
+        (d_kc, d_vc, d_ks, d_vs, _), (props, qs) = jax.lax.scan(
+            draft_body,
+            (d_kc, d_vc, tuple(d_ks), tuple(d_vs), last_tok),
+            jnp.arange(gamma + 1))
         prop_sg = jnp.transpose(props[:gamma])           # (S, γ)
         chunk = jnp.concatenate([last_tok[:, None], prop_sg], axis=1)
 
@@ -148,9 +154,9 @@ def make_spec_round(engine):
             def tfwd(ids_t):
                 return paged_chunk_math(
                     target, t_scratch, ids_t, seq_lens, t_tables,
-                    t_kc, t_vc, live)
+                    t_kc, t_vc, live, ks=t_ks, vs=t_vs)
 
-            (t_logits, t_kc2, t_vc2), _ = functional_call(
+            (t_logits, t_kc2, t_vc2, t_ks2, t_vs2), _ = functional_call(
                 target, tfwd, [Tensor(chunk, stop_gradient=True)], {},
                 t_pv, [])
 
@@ -228,7 +234,7 @@ def make_spec_round(engine):
         last_tok2 = jnp.where(e > 0, new_last, last_tok) \
             .astype(jnp.int32)
         acc = jnp.where(live, a, 0).astype(jnp.int32)
-        return (t_kc2, t_vc2, d_kc, d_vc, seq_lens2, last_tok2,
-                n_gen2, done2, stream, e, acc)
+        return (t_kc2, t_vc2, t_ks2, t_vs2, d_kc, d_vc, d_ks, d_vs,
+                seq_lens2, last_tok2, n_gen2, done2, stream, e, acc)
 
     return spec_round

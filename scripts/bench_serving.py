@@ -93,10 +93,22 @@ plain ``obs="off"`` engine, interleaved windows, median ratio, same
 injector touches host boundaries only). Artifact
 BENCH_RESILIENCE_r14.json.
 
+``serving_int8`` (ISSUE 14) is the quantized-serving acceptance row:
+the same ragged request set through a float engine holding the
+DEQUANTIZED int8 matrices (the exact floats the int8 kernel's fused
+dequant feeds its matmuls — so the weight-only-int8 arm must match it
+bit-for-bit, an equality oracle), a weight-only-int8 arm, and a fully
+quantized arm (int8 weights + int8 KV pool with per-row f32 scales).
+The guarded metric is KV pool residency float/int8 at a deterministic
+allocation point — exactly (4d)/(d+4) by construction, decaying to
+1.0 if the pool silently falls back to float storage. Artifact
+BENCH_INT8_r15.json.
+
 All rows are registered in scripts/bench_suite.py (``serving_engine``,
 ``speculative_decode``, ``speculative_serving``,
 ``serving_obs_overhead``, ``fault_recovery_overhead``,
-``slo_overhead``, ``serving_overload``, ``shared_prefix``);
+``slo_overhead``, ``serving_overload``, ``shared_prefix``,
+``serving_tp``, ``serving_int8``);
 results & methodology in BENCH_NOTES.md, artifact BENCH_SPEC_r07.json.
 """
 from __future__ import annotations
@@ -1106,6 +1118,117 @@ def serving_tp():
     }
 
 
+def serving_int8():
+    """ISSUE 14 acceptance row: the quantized quantum family — the
+    SAME ragged request set through (a) a float engine holding the
+    DEQUANTIZED int8 matrices (``dequant(quant(w))`` — the exact
+    floats the int8 kernel's fused dequant feeds its matmuls), (b) a
+    weight-only-int8 engine, and (c) a fully quantized engine (int8
+    weights + int8 KV pool with per-row f32 scale pools). The
+    weight-only arm must match the dequant arm BIT-FOR-BIT — stream
+    equality, not tolerance (asserted off-TPU where params are f32;
+    recorded on TPU where bf16 storage rounds the oracle). Guarded
+    metric: KV pool residency float/int8 at a deterministic
+    allocation point (full slate admitted, read after one step) —
+    exactly (4d)/(d+4) by construction when the pool really stores
+    int8 rows + f32 scales (3.2x at the smoke's head_dim 16),
+    decaying to 1.0 on a silent float fallback. Decode-quantum ms and
+    the int8-KV arm's stream agreement vs the weight-only arm ride
+    along (per-row KV scales perturb logits within quantization
+    error; agreement is informational, not the claim)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer.common import Linear
+    from paddle_tpu.nn.quant import weight_quantize
+    from paddle_tpu.serving import ServingEngine
+
+    cfg, on_tpu = _serving_cfg()
+    rng = np.random.RandomState(0)
+    requests = _request_set(cfg, on_tpu, rng)
+    if on_tpu:
+        num_slots, block_size, quantum, chunk = 8, 32, 16, 128
+    else:
+        num_slots, block_size, quantum, chunk = 4, 8, 8, 8
+
+    def dequantize_in_place(layer):
+        # the oracle arm: every Linear weight becomes the float matrix
+        # the quantized kernel reconstructs inside its matmul
+        for sub in layer._sub_layers.values():
+            if isinstance(sub, Linear):
+                qw, ws = weight_quantize(sub.weight)
+                deq = (np.asarray(qw._value).astype(np.float32)
+                       * np.asarray(ws._value)[None, :])
+                sub.weight.set_value(paddle.to_tensor(
+                    deq.astype(np.asarray(sub.weight._value).dtype)))
+            else:
+                dequantize_in_place(sub)
+
+    def run_arm(name):
+        model = _build_model(cfg, on_tpu)
+        kw = {}
+        if name == "dequant_float":
+            dequantize_in_place(model)
+        elif name == "w8":
+            kw = dict(quantize="weight_only_int8")
+        elif name == "w8kv8":
+            kw = dict(quantize="weight_only_int8", kv_dtype="int8")
+        eng = ServingEngine(model, num_slots=num_slots,
+                            block_size=block_size, prefill_chunk=chunk,
+                            decode_quantum=quantum, **kw)
+        for p, n in requests[:2]:
+            eng.submit(p, max_new_tokens=n)
+        eng.run()  # compile pass
+        eng.obs.reset()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=n) for p, n in requests]
+        # one step admits a full slate: residency is read at the same
+        # deterministic allocation point in every arm (block demand is
+        # set by prompt lengths, not weight values)
+        eng.step()
+        resid = eng.pool.bytes_in_use()
+        eng.run()
+        wall = time.perf_counter() - t0
+        h = eng.obs.registry.get("serving_quantum_seconds")
+        arm = {
+            "arm": name,
+            "tok_s": round(sum(n for _, n in requests) / wall, 1),
+            "wall_s": round(wall, 2),
+            "decode_quantum_ms_mean": round(
+                1e3 * h.sum(kind="decode")
+                / max(h.count(kind="decode"), 1), 2),
+            "pool_bytes_step1": int(resid),
+            "kv_dtype": eng.pool.fragmentation_stats()["kv_dtype"],
+            "pool_quantized": bool(eng.pool.quantized),
+        }
+        return arm, [list(map(int, eng.output_tokens(r)))
+                     for r in reqs]
+
+    deq, s_deq = run_arm("dequant_float")
+    w8, s_w8 = run_arm("w8")
+    q, s_q = run_arm("w8kv8")
+    oracle_exact = s_w8 == s_deq
+    if not on_tpu:
+        assert oracle_exact, ("weight-only-int8 streams must equal "
+                              "the dequantized-float oracle")
+    agreement = sum(a == b for a, b in zip(s_q, s_w8)) / len(s_q)
+    metric = "serving_int8_pool_residency_ratio"
+    if not on_tpu:
+        metric += "_cpu_smoke"
+    return {
+        "metric": metric,
+        "value": round(deq["pool_bytes_step1"]
+                       / max(q["pool_bytes_step1"], 1), 3),
+        "unit": "x",
+        "weight_oracle_streams_bit_identical": bool(oracle_exact),
+        "kv_int8_stream_agreement": round(agreement, 3),
+        "quantum_ms_int8_over_float": round(
+            q["decode_quantum_ms_mean"]
+            / max(deq["decode_quantum_ms_mean"], 1e-9), 3),
+        "num_requests": len(requests),
+        "num_slots": num_slots, "block_size": block_size,
+        "float_arm": deq, "w8_arm": w8, "w8kv8_arm": q,
+    }
+
+
 def speculative_decode():
     """VERDICT weak #1: speculative greedy decode tok/s vs the
     single-dispatch loop, with acceptance rate — both the realistic
@@ -1317,6 +1440,7 @@ CONFIGS = {
     "serving_overload": serving_overload,
     "shared_prefix": shared_prefix,
     "serving_tp": serving_tp,
+    "serving_int8": serving_int8,
 }
 
 

@@ -15,7 +15,7 @@ serving_engine | speculative_decode | speculative_serving |
 serving_obs_overhead | fault_recovery_overhead |
 attribution_overhead | slo_overhead |
 serving_overload |
-shared_prefix | serving_tp
+shared_prefix | serving_tp | serving_int8
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -1031,6 +1031,16 @@ def serving_tp():
     return _bench_serving().serving_tp()
 
 
+def serving_int8():
+    """Quantized-serving acceptance row (ISSUE 14): the same ragged
+    request set through dequantized-float / weight-only-int8 / fully
+    quantized (int8 weights + int8 KV) engines — the weight-only arm
+    must equal the dequant oracle bit-for-bit, and the guarded
+    (4d)/(d+4) pool-residency ratio proves the int8 pool is real
+    (see scripts/bench_serving.py, artifact BENCH_INT8_r15.json)."""
+    return _bench_serving().serving_int8()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -1044,6 +1054,7 @@ CONFIGS = {
     "serving_overload": serving_overload,
     "shared_prefix": shared_prefix,
     "serving_tp": serving_tp,
+    "serving_int8": serving_int8,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -67,6 +67,21 @@ python -m paddle_tpu.analysis --check --fingerprint
 # stay bit-exact vs the fault-free arm and the pools must drain to
 # zero leaked blocks; the full 200-round soak lives in
 # tests/test_resilience.py (slow) and scripts/soak.py.
+#
+# Quantized-serving gate (ISSUE 14): `--check --fingerprint` above
+# also audits `serving_int8_step` — the weight-only-int8 + int8-KV
+# decode quantum. Its budget demands quantization is LIVE in the
+# compiled graph (min_int8_matmuls=10 contractions fed from int8
+# storage; a silently-disabled quant path would stream bit-identical
+# tokens but blows this floor), keeps 0 host callbacks + full pool
+# donation, and pins temp/peak bytes (~613 KB / ~286 KB audited).
+# Every float recipe's golden must stay byte-identical — the KV scale
+# pools ride the quantum signature as EMPTY pytrees when unquantized,
+# so the float graphs never see them. `obs check` then runs the int8
+# smoke: a forced prefix hit + COW on an int8 pool whose streams are
+# bit-identical to the unshared int8 engine, a >=2x pool-residency
+# win over the float twin, and the dtype-labeled serving_pool_bytes
+# gauge live in the registry.
 python -m paddle_tpu.obs check
 # Perf sentinel (ISSUE 10): the runtime twin of the graph gate —
 # validate/index the BENCH_*.json trajectory and enforce the declared

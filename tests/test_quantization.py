@@ -186,3 +186,62 @@ def test_int8_llama_decode_parity_and_predictor(tmp_path):
     got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     want = q_model(ids).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# -------------------------------------------- round-trip property tests
+@pytest.mark.parametrize("algo", ["weight_only_int8", "llm.int8"])
+def test_weight_quantize_roundtrip_bound_property(algo):
+    """Per-out-channel round-trip bound, both algos: |w - deq(q(w))|
+    <= scale/2 elementwise (round-to-nearest), across random draws
+    spanning 6 decades of per-channel magnitude, with an all-zero
+    out-channel (reconstructs exactly zero through the 1e-8 scale
+    floor) and adjacent tiny/huge channels (one channel's dynamic
+    range must never bleed into another's scale)."""
+    from paddle_tpu.nn.quant import weight_dequantize
+
+    rng = np.random.RandomState(3)
+    for _ in range(5):
+        w_np = (rng.randn(24, 12)
+                * 10.0 ** rng.uniform(-3, 3, (1, 12))).astype("f4")
+        w_np[:, 0] = 0.0
+        w_np[:, 1] = rng.randn(24).astype("f4") * 1e-6
+        w_np[:, 2] = rng.randn(24).astype("f4") * 1e6
+        w = paddle.to_tensor(w_np)
+        qw, scale = weight_quantize(w, algo=algo)
+        assert str(qw.dtype).endswith("int8")
+        s_np = np.asarray(scale._value)
+        assert s_np.shape == (12,) and (s_np > 0).all()
+        back = np.asarray(weight_dequantize(qw, scale, algo=algo)._value)
+        bound = s_np[None, :] * (0.5 + 1e-5)
+        assert (np.abs(back - w_np) <= bound).all()
+        assert (back[:, 0] == 0.0).all()
+        # per-channel scales: the huge channel's presence must not
+        # coarsen the tiny channel below its own round-trip bound
+        assert np.abs(back[:, 1] - w_np[:, 1]).max() <= s_np[1]
+
+
+def test_quantize_kv_rows_roundtrip_and_row_locality():
+    """The KV-row quantizer's two contracts: the per-row round-trip
+    bound (|x - q*s| <= s/2 over the head_dim axis, zero rows exact),
+    and ROW LOCALITY — a row's (q, scale) depends only on that row's
+    own values, the invariant that makes int8 pool content independent
+    of chunk/quantum decomposition and keeps COW sharers bit-stable."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.quant import quantize_kv_rows
+
+    rng = np.random.RandomState(4)
+    x = (rng.randn(3, 4, 2, 16)
+         * 10.0 ** rng.uniform(-4, 4, (3, 4, 2, 1))).astype("f4")
+    x[0, 0] = 0.0
+    q, s = quantize_kv_rows(jnp.asarray(x))
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    s_np = np.asarray(s)
+    assert s_np.shape == x.shape[:-1] and (s_np > 0).all()
+    back = np.asarray(q, dtype=np.float32) * s_np[..., None]
+    assert (np.abs(back - x) <= s_np[..., None] * (0.5 + 1e-5)).all()
+    assert (back[0, 0] == 0.0).all()
+    # row locality: quantizing any sub-slab reproduces the same rows
+    q2, s2 = quantize_kv_rows(jnp.asarray(x[1:2]))
+    np.testing.assert_array_equal(np.asarray(q[1:2]), np.asarray(q2))
+    np.testing.assert_array_equal(s_np[1:2], np.asarray(s2))

@@ -1,0 +1,336 @@
+"""Quantized serving end-to-end (ISSUE 14): int8 weights + int8 KV
+through the quantum family, the spec round, and prefix/COW sharing.
+
+Engine level: the weight-only int8 engine is BIT-EXACT against a float
+engine holding the dequantized weights (the dequant-into-the-matmul
+multiply is IEEE-exact per element, so the oracle is equality, not
+tolerance); the fixed-seed sampling arm and the speculative round with
+draft == target both replay the plain int8 sampling engine bit-for-bit;
+greedy streams are invariant to how a sequence is decomposed into
+prefill chunks / decode quanta (the per-row KV scale depends only on
+the row's own values); and a prefix-shared int8 engine stays
+bit-identical to the unshared one through a real hit + COW.
+
+Pool level: COW on an int8 pool copies the scale rows with the block
+(the writer's divergence never moves a sharer's dequantized values),
+LRU eviction reclaims scale rows with their blocks, dtype-aware byte
+accounting tracks actual itemsize + scale bytes, and a 100-round
+seeded ragged churn leaks nothing on target- and draft-shaped int8
+pools.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nlp import PagedKVCachePool
+from paddle_tpu.nn.layer.common import Linear
+from paddle_tpu.nn.quant import quantize_kv_rows, weight_quantize
+from paddle_tpu.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return LlamaConfig.tiny(tensor_parallel=False)
+
+
+def _fresh_model(cfg):
+    """Each quantized engine needs its OWN model: the quantize sweep
+    rewrites the Linear layers in place. Same seed -> same weights."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _dequantize_weights_in_place(model):
+    """The parity oracle's reference: every Linear weight replaced by
+    ``dequant(quant(w))`` — the exact float matrix the int8 engine's
+    fused dequant feeds its matmuls."""
+    def walk(layer):
+        for sub in layer._sub_layers.values():
+            if isinstance(sub, Linear):
+                qw, ws = weight_quantize(sub.weight)
+                deq = (np.asarray(qw._value).astype(np.float32)
+                       * np.asarray(ws._value)[None, :])
+                sub.weight.set_value(paddle.to_tensor(deq))
+            else:
+                walk(sub)
+
+    walk(model)
+    return model
+
+
+def _run(model, prompts, max_new, seeds=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("decode_quantum", 3)
+    eng = ServingEngine(model, **kw)
+    reqs = [eng.submit(p, max_new_tokens=mn, req_id=f"r{i}",
+                       seed=seeds[i] if seeds else 0)
+            for i, (p, mn) in enumerate(zip(prompts, max_new))]
+    eng.run()
+    return eng, [list(r.tokens) for r in reqs]
+
+
+def _prompts(cfg, seed=0, lens=(5, 9)):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+            for n in lens]
+
+
+# ------------------------------------------------ engine parity oracles
+def test_weight_only_engine_bit_exact_vs_dequant_float(tiny_cfg):
+    """weight_only_linear computes ``x @ (wq.astype(f32) * ws)`` — per
+    element IEEE-exact dequant, so the int8-weight engine must equal a
+    float engine holding those dequantized matrices BIT-FOR-BIT, not
+    within tolerance. The same run pins the int8-KV arm against it:
+    same weights + int8 pool must still produce the identical greedy
+    streams on this fixture (per-row scales keep the tiny-logit
+    argmaxes stable)."""
+    prompts = _prompts(tiny_cfg)
+    max_new = [6, 5]
+    ref = _dequantize_weights_in_place(_fresh_model(tiny_cfg))
+    _, want = _run(ref, prompts, max_new)
+    q_eng, got = _run(_fresh_model(tiny_cfg), prompts, max_new,
+                      quantize="weight_only_int8")
+    assert got == want
+    assert not q_eng.pool.quantized  # weights-only: pool stays float
+    kv_eng, got_kv = _run(_fresh_model(tiny_cfg), prompts, max_new,
+                          quantize="weight_only_int8", kv_dtype="int8")
+    assert got_kv == want
+    assert kv_eng.pool.quantized
+    # dtype-aware accounting: the int8 pool pins well under half the
+    # float pool's bytes for the same allocated blocks
+    st_f, st_q = (e.pool.fragmentation_stats() for e in (q_eng, kv_eng))
+    assert st_q["kv_dtype"] == "int8" and st_f["kv_dtype"] != "int8"
+    per_f = st_f["bytes_in_use"] / max(st_f["blocks_in_use"], 1)
+    per_q = st_q["bytes_in_use"] / max(st_q["blocks_in_use"], 1)
+    assert 0 < per_q < 0.5 * per_f
+
+
+@pytest.mark.slow
+def test_int8_sampling_and_spec_round_parity_fixed_seeds(tiny_cfg):
+    """The sampling arm on a fully quantized engine is deterministic
+    on fixed seeds, and the speculative round with draft == target
+    (both swept int8, BOTH pools int8 with their own scale pools)
+    replays it bit-for-bit — q == p so every proposal accepts, and the
+    fold_in(key, n_emitted) stream discipline carries over unchanged
+    because quantization touches storage, not the token-draw path."""
+    prompts = _prompts(tiny_cfg, seed=2, lens=(5, 7))
+    max_new = [5, 5]
+    kw = dict(quantize="weight_only_int8", kv_dtype="int8",
+              decode_strategy="sampling", top_k=8, temperature=0.9)
+    _, want = _run(_fresh_model(tiny_cfg), prompts, max_new,
+                   seeds=[0, 1], **kw)
+    model = _fresh_model(tiny_cfg)
+    spec, got = _run(model, prompts, max_new, seeds=[0, 1],
+                     spec_draft=model, spec_gamma=2, **kw)
+    assert got == want
+    assert spec.pool.quantized and spec.d_pool.quantized
+    st = spec.engine_stats()
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] == st["spec_proposed"]  # q == p
+
+
+@pytest.mark.slow
+def test_int8_greedy_invariant_to_chunk_quantum_decomposition(tiny_cfg):
+    """A KV row's scale depends only on that row's own values, so the
+    quantized pool content — and every downstream logit — is identical
+    no matter how the sequence is cut into prefill chunks and decode
+    quanta."""
+    prompts = _prompts(tiny_cfg, seed=4, lens=(6, 10))
+    max_new = [6, 5]
+    kw = dict(quantize="weight_only_int8", kv_dtype="int8")
+    _, a = _run(_fresh_model(tiny_cfg), prompts, max_new,
+                prefill_chunk=4, decode_quantum=3, **kw)
+    _, b = _run(_fresh_model(tiny_cfg), prompts, max_new,
+                prefill_chunk=8, decode_quantum=2, **kw)
+    assert a == b
+
+
+@pytest.mark.slow
+def test_int8_prefix_shared_streams_bit_identical(tiny_cfg):
+    """Sharing composes with quantization: an int8 engine with the
+    prefix cache on — through a real hit AND a real COW (the bare
+    system prompt's capped re-prefill) — matches the unshared int8
+    engine bit-for-bit."""
+    rng = np.random.RandomState(3)
+    sys_p = rng.randint(1, tiny_cfg.vocab_size, 8).astype(np.int32)
+    tail = rng.randint(1, tiny_cfg.vocab_size, 3).astype(np.int32)
+    prompts = [np.concatenate([sys_p, tail]), sys_p.copy()]
+    max_new = [5, 4]
+    kw = dict(quantize="weight_only_int8", kv_dtype="int8")
+
+    def run_seq(model, **extra):
+        # sequential submits: the follower only sees a published prefix
+        # if the leader finished first — that ordering IS the hit
+        eng = ServingEngine(model, num_slots=2, block_size=4,
+                            prefill_chunk=4, decode_quantum=3,
+                            **kw, **extra)
+        outs = []
+        for i, (p, mn) in enumerate(zip(prompts, max_new)):
+            r = eng.submit(p, max_new_tokens=mn, req_id=f"r{i}", seed=0)
+            eng.run()
+            outs.append(list(r.tokens))
+        return eng, outs
+
+    _, want = run_seq(_fresh_model(tiny_cfg))
+    shared, got = run_seq(_fresh_model(tiny_cfg), prefix_cache=True)
+    assert got == want
+    assert shared.pool.prefix_hits >= 2
+    assert shared.pool.cow_copies >= 1
+
+
+# ------------------------------------------------ int8 pool mechanics
+def _i8pool(num_blocks=8, bs=4, hk=2, d=8, prefix=True):
+    return PagedKVCachePool(num_blocks=num_blocks, block_size=bs,
+                            num_kv_heads=hk, head_dim=d,
+                            dtype=jnp.float32, kv_dtype="int8",
+                            prefix_cache=prefix)
+
+
+def _audit(pool):
+    """Refcount-granularity leak oracle (same as test_prefix_cache's),
+    plus the int8 pool's dtype-aware byte accounting: bytes_in_use
+    must be exactly blocks_in_use x the per-block cost of int8 rows +
+    f32 scale rows."""
+    expect = {}
+    for table in pool._tables.values():
+        for b in table:
+            expect[b] = expect.get(b, 0) + 1
+    for b in pool._cached_blocks:
+        expect[b] = expect.get(b, 0) + 1
+    assert expect == pool._refcounts
+    assert len(pool._free) + len(expect) == pool.num_blocks
+    st = pool.fragmentation_stats()
+    assert 0.0 <= st["utilization"] <= 1.0
+    assert st["blocks_in_use"] == len(expect)
+    assert st["kv_dtype"] == "int8"
+    rows = pool.block_size * pool.num_kv_heads
+    per_block = 2 * pool.num_layers * rows * (pool.head_dim * 1 + 4)
+    assert st["bytes_in_use"] == len(expect) * per_block
+    assert pool.bytes_in_use() == st["bytes_in_use"]
+
+
+def _fill_block(pool, blk, content):
+    """Write REAL quantized rows + their scales into one block."""
+    q, s = quantize_kv_rows(jnp.asarray(content))
+    pool.k_pools[0] = pool.k_pools[0].at[blk].set(q)
+    pool.k_scales[0] = pool.k_scales[0].at[blk].set(s)
+
+
+def _dequant_block(pool, blk):
+    return (np.asarray(pool.k_pools[0][blk], np.float32)
+            * np.asarray(pool.k_scales[0][blk])[..., None])
+
+
+def test_cow_copies_scale_rows_sharer_dequant_bit_stable():
+    """First write into a shared int8 block lands in a fresh copy THAT
+    CARRIES THE SCALE ROWS; the writer then diverging (new content AND
+    new scales) must not move a single bit of the sharer's dequantized
+    values."""
+    pool = _i8pool()
+    toks = np.arange(8, dtype=np.int32)
+    rng = np.random.RandomState(5)
+    content = rng.randn(2, 4, 2, 8).astype(np.float32) * 3.0
+    pool.ensure("a", 8)
+    for i, blk in enumerate(pool._tables["a"]):
+        _fill_block(pool, blk, content[i])
+    pool.publish_prefix("a", toks)
+    assert pool.attach_prefix("b", toks) == 8
+    shared = list(pool._tables["b"])
+    before = [_dequant_block(pool, b) for b in shared]
+    assert pool.make_writable("b", 4, 8) == 1  # tail block only
+    fresh = pool._tables["b"][1]
+    assert fresh != shared[1]
+    np.testing.assert_array_equal(
+        np.asarray(pool.k_scales[0][fresh]),
+        np.asarray(pool.k_scales[0][shared[1]]))
+    # the writer diverges in BOTH the int8 rows and the scale rows
+    _fill_block(pool, fresh, content[1] * 7.0)
+    for blk, want in zip(shared, before):
+        np.testing.assert_array_equal(_dequant_block(pool, blk), want)
+    assert pool.cow_copies == 1
+    _audit(pool)
+
+
+def test_int8_pool_lru_eviction_reclaims_scale_rows():
+    """Eviction on the quantized pool: refcount-respecting, LRU
+    leaf-first — and every reclaimed block returns its scale bytes to
+    the dtype-aware accounting."""
+    pool = _i8pool()
+    old = np.arange(8, dtype=np.int32)
+    new = np.arange(100, 108, dtype=np.int32)
+    pool.ensure("a", 8)
+    pool.publish_prefix("a", old)
+    pool.ensure("b", 8)
+    pool.publish_prefix("b", new)
+    assert pool.evict_prefix(8) == 0  # live holders pin everything
+    bytes_live = pool.bytes_in_use()
+    pool.free("a")
+    pool.free("b")
+    assert pool.bytes_in_use() == bytes_live  # cache still holds all 4
+    assert pool.evict_prefix(1) == 1          # old chain's leaf first
+    assert pool.match_prefix(old) == 4
+    assert pool.match_prefix(new) == 8
+    rows = pool.block_size * pool.num_kv_heads
+    per_block = 2 * pool.num_layers * rows * (pool.head_dim + 4)
+    assert pool.bytes_in_use() == bytes_live - per_block
+    _audit(pool)
+
+
+@pytest.mark.parametrize(
+    "geom", [(16, 4, 2, 8), (12, 4, 1, 4)], ids=["target", "draft"])
+def test_int8_pool_ragged_churn_100_rounds_zero_leaks(geom):
+    """100 seeded rounds of ragged admit/attach/publish/COW/trim/free/
+    evict on an int8 pool — target- and draft-shaped — with the
+    refcount + byte-accounting audit after EVERY round."""
+    nb, bs, hk, d = geom
+    rng = np.random.RandomState(2)  # this seed hits COW on both geoms
+    pool = PagedKVCachePool(num_blocks=nb, block_size=bs,
+                            num_kv_heads=hk, head_dim=d,
+                            dtype=jnp.float32, kv_dtype="int8",
+                            prefix_cache=True)
+    live, counter = {}, 0
+    for _ in range(100):
+        op = rng.rand()
+        if op < 0.55 and len(live) < 6:
+            sid = f"s{counter}"
+            counter += 1
+            toks = rng.randint(0, 3,
+                               rng.randint(1, 21)).astype(np.int32)
+            try:
+                matched = pool.attach_prefix(sid, toks)
+                pool.ensure(sid, len(toks))
+                if rng.rand() < 0.25:
+                    pool.make_writable(sid, 0, len(toks))
+                else:
+                    pool.make_writable(sid, matched, len(toks))
+                pool.publish_prefix(sid, toks)
+                live[sid] = toks
+            except RuntimeError:
+                pool.free(sid)  # exhausted mid-growth: roll back
+                if live:
+                    victim = list(live)[rng.randint(len(live))]
+                    live.pop(victim)
+                    pool.free(victim)
+        elif op < 0.75 and live:
+            victim = list(live)[rng.randint(len(live))]
+            live.pop(victim)
+            pool.free(victim)
+        elif op < 0.85 and live:
+            sid = list(live)[rng.randint(len(live))]
+            keep = rng.randint(0, len(live[sid]) + 1)
+            pool.trim(sid, keep)
+        else:
+            pool.evict_prefix(rng.randint(0, 3))
+        _audit(pool)
+    assert pool.prefix_hits > 0 and pool.cow_copies > 0
+    for sid in list(live):
+        pool.free(sid)
+    pool.clear_prefix_cache()
+    assert pool.free_blocks == pool.num_blocks
+    assert pool.bytes_in_use() == 0
