@@ -409,4 +409,27 @@ def default_perf_budgets():
                    "1.0, so no noise band; the weight-only arm's "
                    "bit-identical dequant-oracle streams are "
                    "asserted inside the row itself"),
+        PerfBudget(
+            "cluster-affinity-hit-rate", "BENCH_CLUSTER_r16.json",
+            "serving_cluster_affinity_hit_rate_advantage_cpu_smoke",
+            floor=0.5, noise_frac=0.0,
+            reason="router affinity hit-rate minus round-robin on the "
+                   "multi-tenant shared-prefix trace is EXACTLY 0.75 "
+                   "by construction (routing is a pure host function "
+                   "of the trace: 18/24 keyed requests re-land on "
+                   "their prefix owner under affinity, 0/24 under "
+                   "round-robin with 6 tenants mod 4 replicas) — a "
+                   "broken ring lookup or key-owner tracker decays "
+                   "it toward 0, so no noise band"),
+        PerfBudget(
+            "cluster-admitted-scaling", "BENCH_CLUSTER_r16.json",
+            "serving_cluster_affinity_hit_rate_advantage_cpu_smoke",
+            field="admitted_scaling_1_to_4",
+            floor=2.0, noise_frac=0.0,
+            reason="admitted-request throughput 1->4 replicas under "
+                   "per-door max_waiting backpressure is EXACTLY 2.5 "
+                   "by construction (40/16 at the deterministic "
+                   "index-gated submission points) — a router that "
+                   "stops spreading load collapses it to 1.0, so no "
+                   "noise band"),
     ]

@@ -218,7 +218,19 @@ def create_serving_engine(model, **kwargs):
     visible devices raises with the CPU virtual-device setup
     (``XLA_FLAGS='--xla_force_host_platform_device_count=N'``). See
     :mod:`paddle_tpu.serving` and the README "TP-sharded serving"
-    section."""
+    section.
+
+    CLUSTER TIER: to scale past one engine, build N of these (each
+    with its own freshly built model) and front them with
+    :class:`~paddle_tpu.serving.ClusterRouter` +
+    :class:`~paddle_tpu.serving.ClusterFrontDoor` — prefix-affinity
+    routing on the pool's own
+    :func:`~paddle_tpu.serving.prompt_prefix_key`, health-weighted
+    balancing, prefill/decode disaggregation, and fleet
+    snapshot/restore, all behind the exact same
+    :class:`~paddle_tpu.serving.TokenStream` API (streams
+    bit-identical to a single engine — see the README "Cluster
+    serving" section)."""
     from ..serving import ServingEngine
 
     return ServingEngine(model, **kwargs)
@@ -257,6 +269,18 @@ def serve(model, policy=None, slo=True, flight=True, **kwargs):
     ``submit(..., timeout=)`` bounds each token wait. Remaining
     keyword args forward to the engine
     (:func:`create_serving_engine` documents them).
+
+    CLUSTER: for a multi-replica fleet, wrap N engines (each a
+    :class:`~paddle_tpu.serving.ClusterReplica`, which builds or
+    accepts a door like this one) in a
+    :class:`~paddle_tpu.serving.ClusterRouter` and submit through
+    :class:`~paddle_tpu.serving.ClusterFrontDoor` — the same
+    ``submit``/``TokenStream``/``drain``/``snapshot`` surface with
+    prefix-affinity routing, health-weighted balancing, coordinated
+    shedding, and optional prefill/decode role specialization
+    (``role="prefill"`` / ``"decode"`` replicas, hand-off via
+    recompute-on-resume). Streams stay bit-identical to this
+    single-door path.
 
     ::
 

@@ -32,7 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["PagedKVCachePool"]
+__all__ = ["PagedKVCachePool", "prompt_prefix_key"]
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3
@@ -49,6 +49,36 @@ def _chain_hash(parent_hash, tokens):
     for t in tokens:
         h ^= int(t) & 0xFFFFFFFF
         h = (h * _FNV_PRIME) & _MASK64
+    return h
+
+
+def prompt_prefix_key(tokens, block_size, max_blocks=None):
+    """Public content-address of a prompt's leading FULL blocks — the
+    exact chain key :class:`PagedKVCachePool`'s prefix index stores for
+    the same tokens, so a router keyed on it never alias-routes to a
+    replica whose cache would miss.
+
+    Chains :func:`_chain_hash` from the root (parent hash 0) over each
+    full ``block_size`` slice, identically to the pool's internal
+    ``_match_entries`` walk.  The trailing partial block never enters
+    the pool's index and never enters the key.  ``max_blocks`` caps the
+    walk (routers hash only the leading blocks for speed); ``None``
+    hashes every full block.
+
+    Returns the final 64-bit chain hash, or ``None`` when the prompt
+    has no full block (nothing cacheable to be affine to).
+    """
+    bs = int(block_size)
+    if bs <= 0:
+        raise ValueError(f"block_size must be positive, got {bs}")
+    n = len(tokens) // bs
+    if max_blocks is not None:
+        n = min(n, int(max_blocks))
+    if n <= 0:
+        return None
+    h = 0
+    for i in range(n):
+        h = _chain_hash(h, tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
     return h
 
 

@@ -71,7 +71,9 @@ from .flight import (  # noqa: F401
 from .attribution import (  # noqa: F401
     CostLedger, decode_flops_per_token,
 )
-from .export import MetricsExporter, render_dashboard  # noqa: F401
+from .export import (  # noqa: F401
+    ClusterExporter, MetricsExporter, render_dashboard,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "LATENCY_BUCKETS",
@@ -82,5 +84,5 @@ __all__ = [
     "SLO", "SLOSet", "default_serving_slos",
     "FlightRecorder", "validate_flight_records", "load_flight_records",
     "CostLedger", "decode_flops_per_token",
-    "MetricsExporter", "render_dashboard",
+    "MetricsExporter", "ClusterExporter", "render_dashboard",
 ]

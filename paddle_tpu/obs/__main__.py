@@ -53,7 +53,11 @@ token-for-token, and per-phase seconds sum to the measured quantum
 walls within float tolerance), and the RESILIENCE smoke (ISSUE 13: a
 bounded seeded chaos soak — faults x preemption x COW — must keep
 every non-poisoned stream bit-exact vs the fault-free arm with zero
-leaked blocks). Exit non-zero on drift.
+leaked blocks), and the CLUSTER smoke (ISSUE 15: a 2-replica router
+run on a shared-prefix trace must land affinity hits, fire the
+``serving_router_*`` counters, stream bit-identically to a
+cluster-of-1, and render the merged dashboard's cluster line). Exit
+non-zero on drift.
 """
 from __future__ import annotations
 
@@ -607,6 +611,72 @@ def _check_resilience_smoke():
           f"pools drained clean")
 
 
+def _check_cluster_smoke():
+    """The cluster smoke (ISSUE 15): route a shared-prefix trace
+    through a 2-replica ClusterFrontDoor — the twin prompts must
+    re-land on their prefix owner (affinity hits > 0), the router
+    counters must fire, the streams must be bit-identical to a
+    cluster-of-1 run of the same trace, and the merged ClusterExporter
+    snapshot must render the dashboard's cluster line."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        ClusterFrontDoor, ClusterReplica, ClusterRouter, ServingEngine,
+        no_shed_policy,
+    )
+    from .export import ClusterExporter, render_dashboard
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, cfg.vocab_size, 8).tolist()
+    prompts = [shared + rng.randint(1, cfg.vocab_size,
+                                    2 + i).tolist()
+               for i in range(4)]
+
+    def drive(n_replicas):
+        reps = [ClusterReplica(
+                    f"r{i}",
+                    ServingEngine(model, num_slots=2, block_size=4,
+                                  prefix_cache=True),
+                    policy=no_shed_policy())
+                for i in range(n_replicas)]
+        cfd = ClusterFrontDoor(ClusterRouter(reps, affinity_blocks=2))
+        streams = [cfd.submit(p, max_new_tokens=2, seed=0)
+                   for p in prompts]
+        cfd.run_until_idle()
+        return cfd, [list(s.result()) for s in streams]
+
+    cfd2, out2 = drive(2)
+    cfd1, out1 = drive(1)
+    if out2 != out1:
+        raise AssertionError(
+            f"cluster-of-2 streams diverged from cluster-of-1: "
+            f"{out2} vs {out1}")
+    st = cfd2.router.affinity_stats()
+    if st["keyed_requests"] != len(prompts) or st["affinity_hits"] < 1:
+        raise AssertionError(
+            f"shared prefixes never re-landed on their owner: {st}")
+    reqs = cfd2.router._c_requests
+    routed = int(sum(reqs.value(replica=r.name, reason=reason)
+                     for r in cfd2.router.replicas
+                     for reason in ("affinity", "balance", "failover")))
+    if routed != len(prompts):
+        raise AssertionError(
+            f"router accounted {routed} placements for "
+            f"{len(prompts)} requests")
+    exp = ClusterExporter.for_cluster(cfd2)
+    frame = render_dashboard(exp.registry.snapshot())
+    if " cluster " not in frame:
+        raise AssertionError("dashboard frame missing cluster line")
+    print(f"cluster smoke: routed={routed} "
+          f"affinity_hits={st['affinity_hits']} "
+          f"hit_rate={st['hit_rate']:.2f}, 2-replica streams "
+          f"bit-identical to cluster-of-1, merged dashboard ok")
+
+
 def _cmd_check(args):
     """Instrumented-fingerprint gate: the serving recipes construct
     their engines with full observability ON (analysis/recipes.py);
@@ -674,6 +744,11 @@ def _cmd_check(args):
     except (AssertionError, ValueError, RuntimeError) as e:
         failed = True
         print(f"resilience smoke: FAIL — {e}", file=sys.stderr)
+    try:
+        _check_cluster_smoke()
+    except (AssertionError, ValueError) as e:
+        failed = True
+        print(f"cluster smoke: FAIL — {e}", file=sys.stderr)
     if failed:
         return 1
     print("obs check: instrumentation-enabled fingerprints unchanged")

@@ -3,7 +3,8 @@ event a request passes through on the host scheduler — submit, admit
 (with pool/block context), prefill chunks, first token, decode-quantum
 yields, speculative rounds with acceptance, preempt/resume (the front
 door's eviction pair, with the recompute debt), the resilience tier's
-fault/retry/degrade/restore events (serving/faults.py), retire — with
+fault/retry/degrade/restore events (serving/faults.py), the cluster
+tier's route/handoff placements (serving/cluster.py), retire — with
 DUMP-ON-ANOMALY: when a retiring request's TTFT or e2e latency crosses
 its SLO threshold (obs/slo.py), or its preemptions re-computed more
 cached tokens than ``recompute_threshold`` allows (the cost ledger's
@@ -36,7 +37,8 @@ __all__ = ["FlightRecorder", "validate_flight_records",
 
 EVENT_KINDS = ("submit", "admit", "prefill_chunk", "first_token",
                "decode_quantum", "spec_round", "preempt", "resume",
-               "shed", "retire", "fault", "retry", "degrade", "restore")
+               "shed", "retire", "fault", "retry", "degrade", "restore",
+               "route", "handoff")
 
 _ANOMALY_SIGNALS = ("ttft_seconds", "e2e_latency_seconds")
 
@@ -205,6 +207,20 @@ class FlightRecorder:
         re-emitted."""
         self._event(req, "restore", t,
                     tokens_resumed=int(tokens_resumed))
+
+    def on_route(self, req, t, replica=None, reason=None):
+        """A cluster router placed this request on a replica
+        (``reason`` = ``affinity`` | ``balance`` | ``failover``).
+        Journaled on the CHOSEN replica's recorder, after the engine's
+        own ``submit`` event, so the journal still opens at submit."""
+        self._event(req, "route", t, replica=replica, reason=reason)
+
+    def on_handoff(self, req, t, src=None, dst=None, tokens_prefilled=0):
+        """Disaggregated prefill->decode hand-off: the prefill replica
+        ``src`` published the prompt's blocks and the decode replica
+        ``dst`` re-admitted the request via recompute-on-resume."""
+        self._event(req, "handoff", t, src=src, dst=dst,
+                    tokens_prefilled=int(tokens_prefilled))
 
     def on_shed(self, req, t, reason="shed"):
         """A request refused admission by a load-shedding policy: its

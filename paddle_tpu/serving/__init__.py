@@ -41,6 +41,16 @@ live block tables), and crash recovery via ``engine.snapshot()`` /
 ``ServingEngine.restore()`` (recompute-on-resume, bit-exact greedy
 continuation) — also exposed on the front door.
 
+CLUSTER TIER (:mod:`.cluster`): :class:`ClusterRouter` fronts N
+replicas with prefix-cache affinity (the public
+:func:`~paddle_tpu.nlp.paged_cache.prompt_prefix_key` on a
+consistent-hash ring), health-weighted balancing off each replica's
+serializable load report (WARN demoted, CRITICAL skipped), and
+prefill/decode role disaggregation with recompute-on-resume hand-off;
+:class:`ClusterFrontDoor` keeps the exact :class:`TokenStream` API
+plus cluster-wide drain, shed coordination, and fleet
+snapshot/restore. Streams stay bit-identical to a single-replica run.
+
 The compiled programs are pinned by the ``serving_decode_step`` /
 ``speculative_verify_step`` / ``serving_frontdoor_step`` /
 ``serving_prefix_step`` analysis Budgets (zero involuntary remat,
@@ -59,6 +69,8 @@ from .policy import (
 from .frontend import ServingFrontDoor, TokenStream
 from .faults import FaultInjector, FaultSpec, InjectedFault
 from .resilience import QuantumWatchdog, ResiliencePolicy
+from .cluster import ClusterFrontDoor, ClusterReplica, ClusterRouter
+from ..nlp.paged_cache import prompt_prefix_key
 
 __all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine",
            "make_spec_round",
@@ -66,4 +78,6 @@ __all__ = ["Request", "Scheduler", "SchedulerConfig", "ServingEngine",
            "choose_victim", "no_shed_policy",
            "ServingFrontDoor", "TokenStream",
            "FaultInjector", "FaultSpec", "InjectedFault",
-           "QuantumWatchdog", "ResiliencePolicy"]
+           "QuantumWatchdog", "ResiliencePolicy",
+           "ClusterReplica", "ClusterRouter", "ClusterFrontDoor",
+           "prompt_prefix_key"]

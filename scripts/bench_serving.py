@@ -104,11 +104,27 @@ allocation point — exactly (4d)/(d+4) by construction, decaying to
 1.0 if the pool silently falls back to float storage. Artifact
 BENCH_INT8_r15.json.
 
+``serving_cluster`` (ISSUE 15) is the cluster tier's acceptance row:
+N in-process ``ServingEngine`` replicas behind the
+``ClusterRouter``/``ClusterFrontDoor``. Arm (a): a multi-tenant
+shared-system-prompt trace (tenant-interleaved arrivals) routed by
+prefix affinity vs the round-robin control — the guarded claim is the
+router's affinity HIT-RATE advantage, with the aggregate
+cached-prompt-token ratio alongside; arm (b): admitted-throughput
+scaling replicas 1->4 under per-door queue backpressure with cluster
+shed coordination (a request sheds only when every replica refused).
+Both guarded ratios are DETERMINISTIC — routing is a pure host
+function of the trace, and admission depends only on queue depths at
+the submission points — so their perf budgets carry no noise band;
+cluster-of-4 streams are asserted bit-identical to cluster-of-1 (and
+to the round-robin arm) inside the row. Artifact
+BENCH_CLUSTER_r16.json.
+
 All rows are registered in scripts/bench_suite.py (``serving_engine``,
 ``speculative_decode``, ``speculative_serving``,
 ``serving_obs_overhead``, ``fault_recovery_overhead``,
 ``slo_overhead``, ``serving_overload``, ``shared_prefix``,
-``serving_tp``, ``serving_int8``);
+``serving_tp``, ``serving_int8``, ``serving_cluster``);
 results & methodology in BENCH_NOTES.md, artifact BENCH_SPEC_r07.json.
 """
 from __future__ import annotations
@@ -1429,6 +1445,175 @@ def speculative_serving():
     }
 
 
+def serving_cluster():
+    """ISSUE 15 acceptance row: the cluster tier — (a) prefix-affinity
+    routing vs the round-robin control on a multi-tenant
+    shared-system-prompt trace (router hit-rate + aggregate cached
+    prompt tokens), (b) admitted-throughput scaling replicas 1->4
+    under per-door backpressure with cluster shed coordination. Both
+    guarded ratios are deterministic: routing is a pure host function
+    of the trace and admission depends only on queue depths at the
+    submission points, so no noise band. Cluster-of-4 streams are
+    asserted bit-identical to cluster-of-1 (and to the round-robin
+    arm) inside the row."""
+    from paddle_tpu.serving import (
+        ClusterFrontDoor, ClusterReplica, ClusterRouter,
+        FrontDoorPolicy, ServingEngine, no_shed_policy)
+
+    cfg, on_tpu = _serving_cfg()
+    model = _build_model(cfg, on_tpu)
+    rng = np.random.RandomState(0)
+    if on_tpu:
+        num_slots, block_size, t_steps, chunk = 4, 32, 8, 64
+        n_tenants, per_tenant, sys_blocks = 6, 6, 4
+        tail_lo, tail_hi, n_new = 8, 32, 16
+        n_scale, scale_prompt, scale_new, max_wait = 96, 48, 16, 2
+    else:
+        num_slots, block_size, t_steps, chunk = 2, 8, 4, 8
+        n_tenants, per_tenant, sys_blocks = 6, 4, 2
+        tail_lo, tail_hi, n_new = 2, 6, 4
+        n_scale, scale_prompt, scale_new, max_wait = 40, 10, 4, 2
+
+    # tenant-interleaved arrivals (t0r0 t1r0 ... t0r1 ...): every
+    # tenant's LATER requests re-land where its system prompt is hot
+    # under affinity, while round-robin walks each tenant across
+    # replicas and pays the cold prefill per replica it touches
+    sys_len = sys_blocks * block_size
+    tenants = [rng.randint(1, cfg.vocab_size, sys_len).astype(np.int32)
+               for _ in range(n_tenants)]
+    prompts = []
+    for _ in range(per_tenant):
+        for t in range(n_tenants):
+            tail = rng.randint(1, cfg.vocab_size,
+                               int(rng.randint(tail_lo, tail_hi + 1))
+                               ).astype(np.int32)
+            prompts.append(np.concatenate([tenants[t], tail]))
+    max_ctx = max(int(p.shape[0]) for p in prompts) + max(
+        n_new, scale_new)
+    max_ctx = max(max_ctx, scale_prompt + scale_new)
+    max_ctx = -(-max_ctx // block_size) * block_size
+    pool_blocks = 2 * num_slots * (max_ctx // block_size) + 1
+    wrng = np.random.RandomState(7)
+
+    def mk_cluster(n, strategy, policy):
+        reps = []
+        for i in range(n):
+            eng = ServingEngine(
+                model, num_slots=num_slots, block_size=block_size,
+                num_blocks=pool_blocks, prefill_chunk=chunk,
+                decode_quantum=t_steps, max_context=max_ctx,
+                prefix_cache=True)
+            reps.append(ClusterReplica(f"r{i}", eng, policy=policy))
+        return ClusterFrontDoor(ClusterRouter(
+            reps, affinity_blocks=sys_blocks, strategy=strategy))
+
+    def warm_and_reset(cfd):
+        # DISTINCT random warmup prompts on every replica: compile the
+        # quantum + mixed-step shapes fleet-wide without pre-seeding
+        # any tenant prefix; then reset counters, caches and the
+        # router's placement memory
+        for rep in cfd.replicas:
+            for _ in range(num_slots):
+                p = wrng.randint(1, cfg.vocab_size,
+                                 sys_len + tail_lo).astype(np.int32)
+                rep.engine.submit(p, max_new_tokens=n_new)
+            rep.engine.run()
+            rep.engine.completed.clear()
+            rep.engine.obs.reset()
+            rep.engine.pool.clear_prefix_cache()
+            rep.engine.pool._peak_blocks = \
+                rep.engine.pool.blocks_in_use
+        cfd.router.registry.reset()
+        cfd.router._key_owner.clear()
+        cfd.router._rr_next = 0
+
+    def run_affinity_arm(strategy, n_replicas):
+        cfd = mk_cluster(n_replicas, strategy, no_shed_policy())
+        warm_and_reset(cfd)
+        handles = [cfd.submit(p, max_new_tokens=n_new, seed=0,
+                              req_id=f"q{i}")
+                   for i, p in enumerate(prompts)]
+        cfd.run_until_idle()
+        streams = {s.request.req_id: list(s.result())
+                   for s in handles}
+        router = cfd.router
+        cached = sum(int(r.cached_prefix_tokens)
+                     for rep in cfd.replicas
+                     for r in rep.engine.completed)
+        pool_stats = [rep.engine.pool.prefix_cache_stats()
+                      for rep in cfd.replicas]
+        out = {
+            "replicas": n_replicas, "strategy": strategy,
+            "affinity_hit_rate": round(router._g_hit_rate.value(), 4),
+            "affinity_hits": int(router._c_hits.value()),
+            "keyed_requests": int(router._c_keyed.value()),
+            "cached_prompt_tokens": cached,
+            "prefix_hits_total": sum(s["hits"] for s in pool_stats),
+            "prefix_misses_total": sum(
+                s["misses"] for s in pool_stats),
+        }
+        log(f"  {strategy} x{n_replicas}: hit-rate "
+            f"{out['affinity_hit_rate']}, cached {cached} tok")
+        return out, streams
+
+    aff4, s_aff4 = run_affinity_arm("affinity", 4)
+    rr4, s_rr4 = run_affinity_arm("round_robin", 4)
+    aff1, s_aff1 = run_affinity_arm("affinity", 1)
+    assert s_aff4 == s_aff1 == s_rr4, (
+        "cluster streams must be bit-identical across 1/4 replicas "
+        "and routing strategies")
+
+    # admitted-throughput scaling: 2 submissions per fleet pump is ~2x
+    # one replica's service rate, so the single-replica cluster must
+    # shed on its queue bound while the 4-replica fleet absorbs the
+    # same offered trace — admission depends only on queue depths at
+    # the (index-gated, not clock-gated) submission points
+    scale_reqs = [rng.randint(1, cfg.vocab_size, scale_prompt)
+                  .astype(np.int32) for _ in range(n_scale)]
+
+    def run_scaling(n_replicas):
+        pol = FrontDoorPolicy(max_waiting=max_wait, preempt=False)
+        cfd = mk_cluster(n_replicas, "affinity", pol)
+        warm_and_reset(cfd)
+        admitted = 0
+        for i, p in enumerate(scale_reqs):
+            s = cfd.submit(p, max_new_tokens=scale_new, seed=0)
+            admitted += 0 if s.shed else 1
+            if i % 2 == 1:
+                cfd.pump()
+        cfd.run_until_idle()
+        finished = sum(len(rep.engine.completed)
+                       for rep in cfd.replicas)
+        assert finished == admitted, (finished, admitted)
+        log(f"  scaling x{n_replicas}: admitted {admitted}/{n_scale}")
+        return admitted
+
+    admitted_1 = run_scaling(1)
+    admitted_4 = run_scaling(4)
+
+    metric = "serving_cluster_affinity_hit_rate_advantage"
+    if not on_tpu:
+        metric += "_cpu_smoke"
+    return {
+        "metric": metric,
+        "value": round(aff4["affinity_hit_rate"]
+                       - rr4["affinity_hit_rate"], 4),
+        "unit": "hit-rate delta (affinity - round_robin, 4 replicas)",
+        "admitted_scaling_1_to_4": round(
+            admitted_4 / max(admitted_1, 1), 3),
+        "admitted_1": admitted_1, "admitted_4": admitted_4,
+        "offered_requests": n_scale,
+        "cached_tokens_affinity_over_rr": round(
+            aff4["cached_prompt_tokens"]
+            / max(rr4["cached_prompt_tokens"], 1), 3),
+        "tenants": n_tenants, "requests_per_tenant": per_tenant,
+        "system_prompt_tokens": sys_len, "block_size": block_size,
+        "num_slots": num_slots, "max_waiting": max_wait,
+        "affinity_4": aff4, "round_robin_4": rr4, "affinity_1": aff1,
+        "streams_bit_identical": True,
+    }
+
+
 CONFIGS = {
     "serving_engine": serving_engine,
     "speculative_decode": speculative_decode,
@@ -1441,6 +1626,7 @@ CONFIGS = {
     "shared_prefix": shared_prefix,
     "serving_tp": serving_tp,
     "serving_int8": serving_int8,
+    "serving_cluster": serving_cluster,
 }
 
 

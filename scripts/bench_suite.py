@@ -15,7 +15,7 @@ serving_engine | speculative_decode | speculative_serving |
 serving_obs_overhead | fault_recovery_overhead |
 attribution_overhead | slo_overhead |
 serving_overload |
-shared_prefix | serving_tp | serving_int8
+shared_prefix | serving_tp | serving_int8 | serving_cluster
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -1041,6 +1041,17 @@ def serving_int8():
     return _bench_serving().serving_int8()
 
 
+def serving_cluster():
+    """Cluster-tier acceptance row (ISSUE 15): prefix-affinity routing
+    vs round-robin on a multi-tenant shared-system-prompt trace
+    (router hit-rate advantage + cached-token ratio) and
+    admitted-throughput scaling replicas 1->4 under per-door
+    backpressure with cluster shed coordination; cluster-of-4 streams
+    asserted bit-identical to cluster-of-1 in-run (see
+    scripts/bench_serving.py, artifact BENCH_CLUSTER_r16.json)."""
+    return _bench_serving().serving_cluster()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -1055,6 +1066,7 @@ CONFIGS = {
     "shared_prefix": shared_prefix,
     "serving_tp": serving_tp,
     "serving_int8": serving_int8,
+    "serving_cluster": serving_cluster,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -82,6 +82,15 @@ python -m paddle_tpu.analysis --check --fingerprint
 # bit-identical to the unshared int8 engine, a >=2x pool-residency
 # win over the float twin, and the dtype-labeled serving_pool_bytes
 # gauge live in the registry.
+#
+# Cluster gate (ISSUE 15): the router is pure host code riding the
+# same engines, so `--check --fingerprint` above (0 host callbacks,
+# byte-identical goldens) already proves the cluster tier touches no
+# compiled graph. `obs check` then runs the cluster smoke: a
+# 2-replica ClusterFrontDoor on a shared-prefix trace must re-land
+# twin prompts on their prefix owner (affinity hits live in the
+# serving_router_* counters), stream bit-identical to a cluster-of-1
+# run, and render the merged ClusterExporter dashboard's cluster line.
 python -m paddle_tpu.obs check
 # Perf sentinel (ISSUE 10): the runtime twin of the graph gate —
 # validate/index the BENCH_*.json trajectory and enforce the declared

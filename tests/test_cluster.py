@@ -1,0 +1,472 @@
+"""Cluster tier (ISSUE 15): the multi-engine replica router —
+prefix-affinity placement on the pool's own chain keys, consistent-hash
+redistribution bounds, health gating (WARN demoted / CRITICAL skipped),
+shed coordination (refused only when every replica refused), the
+disaggregated prefill->decode hand-off, cluster drain, fleet
+snapshot/restore, and the merged ClusterExporter scrape.
+
+Router placement units run against stub engines (pure host logic, no
+jax model); everything stream-producing uses the shared tiny llama and
+asserts BIT-IDENTICAL outputs vs a single-replica run — the cluster's
+core correctness contract."""
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nlp.paged_cache import (
+    PagedKVCachePool, _chain_hash, prompt_prefix_key,
+)
+from paddle_tpu.obs import ClusterExporter, MetricsExporter, \
+    render_dashboard
+from paddle_tpu.obs.flight import FlightRecorder, \
+    validate_flight_records
+from paddle_tpu.serving import (
+    BATCH, INTERACTIVE, NORMAL, ClusterFrontDoor, ClusterReplica,
+    ClusterRouter, FrontDoorPolicy, ServingEngine, no_shed_policy,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+# ------------------------------------------------ prompt_prefix_key
+def _pool(num_blocks=16, bs=4):
+    return PagedKVCachePool(num_blocks=num_blocks, block_size=bs,
+                            num_kv_heads=2, head_dim=8,
+                            dtype=jnp.float32, prefix_cache=True)
+
+
+def test_prompt_prefix_key_matches_pool_chain_exactly():
+    """The public key must equal the pool's stored chain hash for the
+    same tokens — the router's no-alias-routing guarantee."""
+    pool = _pool()
+    toks = np.arange(1, 14, dtype=np.int32)  # 3 full blocks + tail
+    pool.ensure("a", len(toks))
+    pool.publish_prefix("a", toks)
+    entries = pool._match_entries(toks)
+    assert len(entries) == 3
+    # full walk == the deepest published entry's hash
+    assert prompt_prefix_key(toks, 4) == entries[-1].hash
+    # every capped walk == the entry at that depth
+    for d in (1, 2, 3):
+        assert prompt_prefix_key(toks, 4, max_blocks=d) \
+            == entries[d - 1].hash
+    # and the reference chain from the root, by hand
+    h = 0
+    for i in range(3):
+        h = _chain_hash(h, tuple(int(t) for t in toks[4 * i:4 * i + 4]))
+    assert prompt_prefix_key(toks, 4) == h
+
+
+def test_prompt_prefix_key_edges():
+    # no full block -> no key (nothing cacheable to be affine to)
+    assert prompt_prefix_key([1, 2, 3], 4) is None
+    assert prompt_prefix_key([], 4) is None
+    # the tail never enters the key
+    assert prompt_prefix_key([1, 2, 3, 4, 9], 4) \
+        == prompt_prefix_key([1, 2, 3, 4, 7], 4)
+    # depth is part of the key: same block at depth 2 differs
+    assert prompt_prefix_key([1, 2, 3, 4], 4) \
+        != prompt_prefix_key([1, 2, 3, 4] * 2, 4, max_blocks=None)
+    with pytest.raises(ValueError):
+        prompt_prefix_key([1, 2, 3, 4], 0)
+
+
+# ------------------------------------------------ router units (stubs)
+class _StubPool:
+    def __init__(self, block_size):
+        self.block_size = block_size
+        self.free_blocks = 64
+        self.blocks_in_use = 0
+
+
+class _StubSched:
+    def __init__(self):
+        self.waiting = []
+
+    def live(self):
+        return []
+
+
+class _StubObs:
+    def now(self):
+        return 0.0
+
+
+class _StubCfg:
+    num_slots = 4
+
+
+class _StubEngine:
+    """Just enough engine surface for ClusterReplica/ClusterRouter
+    placement logic: pool gauges, scheduler depths, a clock, and the
+    one-front-door-per-engine token_sink slot."""
+
+    def __init__(self, block_size=4):
+        self.pool = _StubPool(block_size)
+        self.scheduler = _StubSched()
+        self.obs = _StubObs()
+        self.config = _StubCfg()
+        self.token_sink = None
+        self.flight = None
+        self.slo = None
+
+
+def _stub_cluster(n, **kw):
+    reps = [ClusterReplica(f"r{i}", _StubEngine()) for i in range(n)]
+    return reps, ClusterRouter(reps, **kw)
+
+
+def _key_toks(rng, n_blocks=2, bs=4):
+    return rng.integers(1, 1000, size=n_blocks * bs).tolist()
+
+
+def test_router_affinity_stable_and_consistent():
+    """Same key -> same replica, every time; placement order is
+    (affinity head, then failover candidates by load)."""
+    reps, router = _stub_cluster(4, vnodes=32)
+    rng = np.random.default_rng(0)
+    toks = _key_toks(rng)
+    first = router.plan(toks)
+    assert first[0][1] == "affinity"
+    assert all(r == "failover" for _, r in first[1:])
+    assert len(first) == 4
+    for _ in range(5):
+        assert router.plan(toks)[0][0] is first[0][0]
+    # sub-block prompt: balance, never affinity
+    assert router.plan([1, 2, 3])[0][1] == "balance"
+
+
+def test_router_redistribution_bound_on_add_remove():
+    """Consistent hashing's contract: adding one replica to 4 steals
+    only ~1/5 of the keyspace, and every moved key moves TO the new
+    replica — old replicas never shuffle keys among themselves.
+    Removing it restores the original map exactly."""
+    reps, router = _stub_cluster(4, vnodes=64)
+    rng = np.random.default_rng(1)
+    keys = [_key_toks(rng) for _ in range(300)]
+    before = {tuple(k): router.plan(k)[0][0].name for k in keys}
+    router.add_replica(ClusterReplica("r4", _StubEngine()))
+    after = {tuple(k): router.plan(k)[0][0].name for k in keys}
+    moved = [k for k in before if before[k] != after[k]]
+    assert all(after[k] == "r4" for k in moved)
+    frac = len(moved) / len(keys)
+    assert 0.0 < frac < 0.45, f"redistribution {frac:.2f} out of bounds"
+    router.remove_replica("r4")
+    assert {tuple(k): router.plan(k)[0][0].name for k in keys} == before
+
+
+def test_router_health_gating():
+    """CRITICAL replicas are skipped outright; WARN ones lose even
+    their affinity traffic to OK peers; a fully-critical fleet still
+    routes (the per-door policy owns that refusal)."""
+    reps, router = _stub_cluster(3, vnodes=32)
+    rng = np.random.default_rng(2)
+    # find a key owned by r1
+    toks = None
+    for _ in range(200):
+        cand = _key_toks(rng)
+        if router.plan(cand)[0][0].name == "r1":
+            toks = cand
+            break
+    assert toks is not None
+    reps[1].health_state = lambda now: "critical"
+    plan = router.plan(toks)
+    assert all(rep.name != "r1" for rep, _ in plan)
+    assert plan[0][1] == "failover"
+    # WARN: demoted below OK peers, even for its own affinity keys
+    reps[1].health_state = lambda now: "warn"
+    plan = router.plan(toks)
+    assert all(rep.name != "r1" for rep, _ in plan)
+    # ...but an all-warn fleet still serves, affinity restored
+    for r in reps:
+        r.health_state = lambda now: "warn"
+    assert router.plan(toks)[0][0].name == "r1"
+    # all critical: last resort keeps routing
+    for r in reps:
+        r.health_state = lambda now: "critical"
+    assert len(router.plan(toks)) == 3
+
+
+def test_router_balance_and_round_robin():
+    reps, router = _stub_cluster(3, vnodes=32)
+    # balance: least-loaded (waiting, live, blocks) wins ties by name
+    reps[0].engine.scheduler.waiting = [1, 2]
+    reps[1].engine.scheduler.waiting = [1]
+    assert router.plan([1, 2, 3])[0][0].name == "r2"
+    # round-robin control arm cycles regardless of key
+    _, rr = _stub_cluster(3, strategy="round_robin")
+    toks = [5, 6, 7, 8]
+    order = [rr.plan(toks)[0][0].name for _ in range(6)]
+    assert order == ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+
+def test_router_load_report_serializable():
+    reps, router = _stub_cluster(2)
+    reports = router.load_reports()
+    parsed = json.loads(json.dumps(reports))
+    assert parsed[0]["replica"] == "r0"
+    assert set(parsed[0]) >= {"state", "waiting", "live", "slots",
+                              "free_blocks", "blocks_in_use", "role"}
+
+
+def test_router_rejects_mismatched_fleets():
+    a, b = _StubEngine(block_size=4), _StubEngine(block_size=8)
+    with pytest.raises(ValueError, match="block_size"):
+        ClusterRouter([ClusterReplica("a", a), ClusterReplica("b", b)])
+    with pytest.raises(ValueError, match="duplicate"):
+        e1, e2 = _StubEngine(), _StubEngine()
+        ClusterRouter([ClusterReplica("x", e1),
+                       ClusterReplica("x", e2)])
+    with pytest.raises(ValueError):
+        ClusterRouter([])
+
+
+# ------------------------------------------------ live-cluster e2e
+def _mk_replica(model, name, role="general", policy=None, flight=False,
+                **eng_kw):
+    kw = dict(num_slots=2, block_size=4, prefix_cache=True)
+    kw.update(eng_kw)
+    if flight:
+        kw["flight"] = FlightRecorder()
+    eng = ServingEngine(model, **kw)
+    return ClusterReplica(name, eng, role=role,
+                          policy=policy or no_shed_policy())
+
+
+def _trace(cfg, n=8, seed=3):
+    """Seeded ragged trace with two shared system prefixes — the
+    affinity router's bread and butter."""
+    rng = np.random.default_rng(seed)
+    sys_a = rng.integers(1, cfg.vocab_size, size=8).tolist()
+    sys_b = rng.integers(1, cfg.vocab_size, size=8).tolist()
+    prompts = []
+    for i in range(n):
+        tail = rng.integers(1, cfg.vocab_size,
+                            size=int(rng.integers(2, 7))).tolist()
+        prompts.append((sys_a if i % 2 else sys_b) + tail)
+    return prompts
+
+
+def _run_cluster(model, prompts, n_replicas, max_new_tokens=2, **kw):
+    reps = [_mk_replica(model, f"r{i}") for i in range(n_replicas)]
+    cfd = ClusterFrontDoor(ClusterRouter(reps, affinity_blocks=2, **kw))
+    streams = [cfd.submit(p, max_new_tokens=max_new_tokens, seed=0)
+               for p in prompts]
+    cfd.run_until_idle()
+    return cfd, {s.request.req_id: list(s.result()) for s in streams}
+
+
+@pytest.fixture(scope="module")
+def canon(tiny_model):
+    """ONE canonical shared-prefix trace + its cluster-of-1 reference
+    streams — the bit-identity oracle every live test below compares
+    against (cluster-of-N == cluster-of-1 is the tier's contract, so
+    one reference run serves them all and the tier-1 clock)."""
+    cfg, model = tiny_model
+    prompts = _trace(cfg, n=4)
+    _, ref = _run_cluster(model, prompts, 1)
+    return prompts, ref
+
+
+def test_cluster_of_4_bit_identical_to_cluster_of_1(tiny_model, canon):
+    """THE contract: callers cannot tell one replica from four — every
+    stream byte-identical on the same seeded ragged trace, and the
+    shared system prompts actually hit the affinity path."""
+    cfg, model = tiny_model
+    prompts, ref = canon
+    cfd4, out4 = _run_cluster(model, prompts, 4)
+    assert out4 == ref
+    st = cfd4.router.affinity_stats()
+    assert st["keyed_requests"] == len(prompts)
+    assert st["affinity_hits"] > 0          # shared prefixes re-landed
+    reqs = cfd4.router._c_requests
+    assert sum(reqs.value(replica=f"r{i}", reason="affinity")
+               for i in range(4)) == len(prompts)
+
+
+def test_cluster_shed_coordination_failover(tiny_model):
+    """A request sheds only when EVERY eligible replica refused it:
+    with per-door backpressure at max_waiting=1, the second
+    same-prefix submission fails over instead of shedding; a third
+    finds the whole fleet full and is refused everywhere."""
+    cfg, model = tiny_model
+    pol = FrontDoorPolicy(max_waiting=1, preempt=False,
+                          backpressure_exempt=INTERACTIVE)
+    reps = [_mk_replica(model, f"r{i}", policy=pol) for i in range(2)]
+    cfd = ClusterFrontDoor(ClusterRouter(reps, affinity_blocks=1))
+    p = list(range(1, 9))
+    s1 = cfd.submit(p, max_new_tokens=1, seed=0)       # affinity home
+    s2 = cfd.submit(p, max_new_tokens=1, seed=0)       # home full -> fo
+    s3 = cfd.submit(p, max_new_tokens=1, seed=0)       # fleet full
+    assert not s1.shed and not s2.shed
+    assert s3.shed
+    reqs = cfd.router._c_requests
+    assert sum(reqs.value(replica=f"r{i}", reason="failover")
+               for i in range(2)) == 1
+    assert cfd.router._c_shed.value(reason="cluster_full") == 1
+    cfd.run_until_idle()
+    assert list(s1.result()) == list(s2.result())
+
+
+def test_cluster_victim_selection_on_full_cluster(tiny_model):
+    """An INTERACTIVE arrival on a pool-tight replica preempts a BATCH
+    victim through the routed door's own ladder — the cluster reuses,
+    not reimplements, per-replica preemption. Distinct prompts and no
+    prefix cache, so every request carries its full block demand."""
+    cfg, model = tiny_model
+    pol = FrontDoorPolicy(preempt=True)
+    reps = [_mk_replica(model, "r0", policy=pol, num_blocks=10,
+                        prefix_cache=False)]
+    cfd = ClusterFrontDoor(ClusterRouter(reps))
+    rng = np.random.default_rng(5)
+    ps = [rng.integers(1, cfg.vocab_size, size=10).tolist()
+          for _ in range(3)]
+    batch = [cfd.submit(ps[i], max_new_tokens=3, priority=BATCH,
+                        seed=0)
+             for i in range(2)]
+    cfd.pump()                       # both live mid-decode, pool tight
+    vip = cfd.submit(ps[2], max_new_tokens=3, priority=INTERACTIVE,
+                     seed=0)
+    cfd.run_until_idle()
+    eng = reps[0].engine
+    assert eng.scheduler.preempted_total >= 1
+    assert not vip.shed and len(vip.result()) == 3
+    for s in batch:
+        assert len(s.result()) == 3
+
+
+def test_cluster_drain_completes_and_exporter_merges(tiny_model, canon):
+    """Two fleet-wide contracts on one workload: (a) ``drain()``
+    finishes every accepted request and post-drain submissions shed
+    with reason ``draining`` on every replica; (b) one
+    :class:`ClusterExporter` scrape of the drained fleet == the union
+    of per-replica scrapes under a ``replica`` label, fleet
+    ``/healthz`` is worst-state-wins, and the watch dashboard renders
+    the cluster line off the merged snapshot."""
+    cfg, model = tiny_model
+    prompts, _ = canon
+    reps = [_mk_replica(model, f"r{i}") for i in range(2)]
+    cfd = ClusterFrontDoor(ClusterRouter(reps, affinity_blocks=2))
+    streams = [cfd.submit(p, max_new_tokens=1, seed=0) for p in prompts]
+    summary = cfd.drain()
+    assert summary["drained"] and summary["completed"] == len(prompts)
+    for s in streams:
+        assert len(s.result()) == 1
+    # post-drain submissions shed on every replica (reason draining)
+    late = cfd.submit(prompts[0], max_new_tokens=1)
+    assert late.shed and late.finish_reason == "shed"
+    assert cfd.router._c_shed.value(reason="draining") == 1
+
+    exp = ClusterExporter.for_cluster(cfd)
+    merged = exp.registry.snapshot()
+    # parity: every per-replica series appears relabeled, same value
+    for rep in reps:
+        for m in rep.engine.obs.registry.snapshot()["metrics"]:
+            mm = next(x for x in merged["metrics"]
+                      if x["name"] == m["name"])
+            for s in m["series"]:
+                want = dict(s.get("labels", {}), replica=rep.name)
+                hit = [x for x in mm["series"] if x["labels"] == want]
+                assert len(hit) == 1, (m["name"], want)
+                if "value" in s:
+                    assert hit[0]["value"] == s["value"]
+    # router series ride unlabeled
+    text = exp.registry.prometheus()
+    assert "serving_router_requests_total" in text
+    assert 'replica="r0"' in text and 'replica="r1"' in text
+    # fleet healthz: all vacuously ok -> 200; force one critical -> 503
+    status, body = exp.healthz()
+    assert status == 200 and body["state"] == "ok"
+    exp._members[1] = (exp._members[1][0], _ForcedCritical())
+    status, body = exp.healthz()
+    assert status == 503 and body["state"] == "critical"
+    assert body["replicas"]["r1"] == "critical"
+    # live HTTP smoke on the merged endpoints
+    import urllib.request
+    with ClusterExporter.for_cluster(cfd) as live:
+        raw = urllib.request.urlopen(
+            live.url("/metrics"), timeout=5).read().decode()
+        assert 'replica="r1"' in raw
+    # the watch dashboard grows a cluster line off the merged snapshot
+    dash = render_dashboard(merged)
+    assert " cluster " in dash and "hit" in dash
+
+
+def test_disaggregated_handoff_bit_identical(tiny_model, canon):
+    """Prefill/decode role split: the prefill replica emits t0 and
+    publishes the prompt's blocks; the decode replica re-admits via
+    recompute-on-resume — the combined stream equals a single-replica
+    run, the hand-off is journaled, and the journals stay
+    schema-valid."""
+    cfg, model = tiny_model
+    prompts, canon_ref = canon
+    prompts = prompts[:2]
+    reps = [_mk_replica(model, "pf", role="prefill", flight=True),
+            _mk_replica(model, "dc", role="decode", flight=True)]
+    cfd = ClusterFrontDoor(ClusterRouter(reps, affinity_blocks=2))
+    streams = [cfd.submit(p, max_new_tokens=2, seed=0)
+               for p in prompts]
+    cfd.run_until_idle()
+    out = {s.request.req_id: list(s.result()) for s in streams}
+    ref = {f"c{i}": canon_ref[f"c{i}"] for i in range(len(prompts))}
+    assert out == ref
+    assert cfd.router._c_handoffs.value() == len(prompts)
+    # prefill side published the prompts' blocks into ITS index
+    assert reps[0].engine.pool.prefix_cache_stats()["cached_blocks"] > 0
+    # flight journals (route + handoff events included) validate
+    for rep in reps:
+        recs = [json.loads(ln) for ln in
+                rep.engine.flight.jsonl().splitlines()]
+        if recs:
+            validate_flight_records(recs)
+        kinds = {e["kind"] for j in rep.engine.flight._live.values()
+                 for e in j["events"]}
+        if rep.role == "decode":
+            assert not kinds & {"submit"}  # all retired by now
+
+
+def test_fleet_snapshot_restore_roundtrip(tiny_model, canon):
+    """Crash mid-decode, restore the whole fleet from the snapshot,
+    finish: streams equal the uninterrupted run, and the router's
+    affinity map survives (a restored cluster keeps routing warm)."""
+    cfg, model = tiny_model
+    prompts, ref = canon
+    reps = [_mk_replica(model, f"r{i}") for i in range(2)]
+    cfd = ClusterFrontDoor(ClusterRouter(reps, affinity_blocks=2))
+    for p in prompts:
+        cfd.submit(p, max_new_tokens=2, seed=0)
+    cfd.pump()                      # partial progress, then "crash"
+    snap = json.loads(json.dumps(cfd.snapshot()))  # JSON round-trip
+    assert snap["kind"] == "serving_cluster_snapshot"
+    restored = ClusterFrontDoor.restore(snap, model,
+                                        policy=no_shed_policy())
+    streams = restored.streams()
+    restored.run_until_idle()
+    out = {rid: list(s.result()) for rid, s in streams.items()}
+    assert out                       # the crash really caught mid-flight
+    done = {rid: toks for rid, toks in ref.items() if rid in out}
+    assert out == done
+    # everything not mid-flight at the snapshot already completed there
+    completed = {str(r.req_id): list(r.tokens)
+                 for rep in cfd.replicas
+                 for r in rep.engine.completed}
+    for rid, toks in ref.items():
+        assert (out.get(rid, completed.get(rid))) == toks
+    assert restored.router._key_owner == cfd.router._key_owner
+
+
+class _ForcedCritical:
+    def health_report(self, now=None):
+        return {"version": 1, "state": "critical", "now": now,
+                "objectives": []}
