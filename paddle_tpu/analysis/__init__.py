@@ -3,7 +3,7 @@ framework source (the CINN-style compiler-level verification layer of
 PAPER.md's blueprint, grown from tests/test_zero_ir.py's one-off IR
 string checks into a first-class subsystem).
 
-Four layers:
+Six layers:
 
 1. **IR audit passes** over any jitted callable's jaxpr / StableHLO /
    compiled HLO: collective-communication census
@@ -36,10 +36,20 @@ Four layers:
    ``BENCH_INDEX.json`` (:func:`build_index` / :func:`compare_index`
    staleness diffs) and the :func:`check_perf` gate run pre-merge by
    ``scripts/check_perf.sh`` via ``scripts/validate_bench.py``.
+6. **Static cost model & roofline**: :mod:`.cost` — per-program
+   FLOP/byte accounting from BOTH XLA's ``cost_analysis()`` and a
+   backend-independent jaxpr walker (:func:`analyze_cost` cross-checks
+   them against the pinned agreement band), chip rooflines
+   (:func:`roofline` — arithmetic intensity, memory/compute-bound,
+   the ``max(flops/peak, bytes/bw)`` device-time floor) and
+   :func:`host_gap_seconds` against measured walls. ``--cost`` gates
+   every recipe's cross-source agreement; the per-recipe caps ride the
+   budgets and the exact numbers ride the golden fingerprints.
 
 CLI: ``python -m paddle_tpu.analysis`` audits the registered recipes
 (``--check`` enforces budgets, ``--fingerprint`` compares goldens,
-``--update-goldens`` regenerates them).
+``--update-goldens`` regenerates them, ``--cost`` prints the
+roofline table and gates cross-source agreement).
 """
 from .ir import LoweredTarget, lower_target, capture_compile_stderr
 from .collectives import (
@@ -69,6 +79,11 @@ from .perf_budget import (
     INDEX_VERSION, PerfBudget, PerfBudgetViolation, build_index,
     check_perf, compare_index, default_perf_budgets, normalize_artifact,
 )
+from .cost import (
+    AGREEMENT_BAND, CHIP_SPECS, ChipSpec, CostReport, CostStats,
+    RooflineReport, analyze_cost, host_gap_seconds, jaxpr_cost,
+    quantum_flops_per_token, roofline, xla_cost_stats,
+)
 
 __all__ = [
     # ir
@@ -95,4 +110,9 @@ __all__ = [
     "INDEX_VERSION", "PerfBudget", "PerfBudgetViolation", "build_index",
     "check_perf", "compare_index", "default_perf_budgets",
     "normalize_artifact",
+    # cost model & roofline
+    "AGREEMENT_BAND", "CHIP_SPECS", "ChipSpec", "CostReport",
+    "CostStats", "RooflineReport", "analyze_cost", "host_gap_seconds",
+    "jaxpr_cost", "quantum_flops_per_token", "roofline",
+    "xla_cost_stats",
 ]

@@ -5,16 +5,21 @@
     python -m paddle_tpu.analysis --check            # enforce budgets
     python -m paddle_tpu.analysis --fingerprint      # compare goldens
     python -m paddle_tpu.analysis --update-goldens   # regenerate them
+    python -m paddle_tpu.analysis --cost [--chip v5e]  # roofline gate
     python -m paddle_tpu.analysis --json             # machine-readable
 
 Audits the registered recipes (see .recipes) — lowering + compiling
 each program and printing the collective census, remat events, dtype
 findings, donation coverage, memory estimate, and sharding layout.
-``--check`` additionally enforces each recipe's budget and
+``--check`` additionally enforces each recipe's budget,
 ``--fingerprint`` compares each live fingerprint against its golden
-(tests/goldens/<recipe>.json, or ``--goldens-dir``); either exits
-non-zero on a violation/drift (the bench-suite / CI entry point —
-scripts/check_graphs.sh runs both plus the linter). After an
+(tests/goldens/<recipe>.json, or ``--goldens-dir``), and ``--cost``
+prints the static cost table (FLOPs, bytes, intensity, roofline floor
+on ``--chip``, host gap vs the checked-in bench walls) while gating
+that both cost sources populated and agree within the pinned band; any
+of the three exits non-zero on a violation/drift (the bench-suite / CI
+entry point — scripts/check_graphs.sh runs all of them plus the
+linter). After an
 INTENTIONAL graph change run ``--update-goldens`` and review the
 goldens' git diff. Source linting is the sibling CLI:
 ``python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/``.
@@ -28,13 +33,101 @@ import sys
 
 from . import recipes
 from .budget import BudgetViolation
+from .cost import (
+    AGREEMENT_BAND, CHIP_SPECS, DEFAULT_CHIP, host_gap_seconds,
+    roofline,
+)
 from .fingerprint import (
     FingerprintMismatch, check_recipe_fingerprint, fingerprint_report,
     save_golden,
 )
 
+#: repo root (three levels above this file) — where the checked-in
+#: BENCH_*.json artifacts that carry measured quantum walls live
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-def _report_json(name, report, ok, violations, fp_status=None):
+# where a measured per-dispatch wall for a recipe can be read from the
+# checked-in artifacts: recipe -> (artifact, row metric, tokens/s field)
+_MEASURED_WALL_SOURCES = {
+    "serving_decode_step": (
+        "BENCH_SERVING_r06.json",
+        "serving_engine_ragged_tokens_per_sec_cpu_smoke",
+        "quantum_decode_tokens_per_sec"),
+}
+
+
+def _measured_wall_s(name, tokens):
+    """Measured wall seconds for ONE dispatch of recipe ``name``, from
+    the checked-in bench artifacts: BENCH_COST_r17.json's in-process
+    quantum timings when present (it measures several recipes), else
+    the serving smoke row's quantum throughput. None when nothing has
+    measured this recipe — the host-gap column then reads n/a."""
+    cost_art = os.path.join(_REPO_ROOT, "BENCH_COST_r17.json")
+    try:
+        with open(cost_art) as f:
+            for row in json.load(f).get("rows", []):
+                if row.get("recipe") == name and isinstance(
+                        row.get("measured_us_per_dispatch"),
+                        (int, float)):
+                    return row["measured_us_per_dispatch"] / 1e6
+    except (OSError, ValueError):
+        pass
+    src = _MEASURED_WALL_SOURCES.get(name)
+    if src is None or not tokens:
+        return None
+    artifact, metric, field = src
+    try:
+        with open(os.path.join(_REPO_ROOT, artifact)) as f:
+            for row in json.load(f).get("rows", []):
+                if row.get("metric") == metric and isinstance(
+                        row.get(field), (int, float)) and row[field] > 0:
+                    return tokens / row[field]
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _cost_gate(name, report, budget, chip):
+    """Roofline/table lines + gate verdict for one audited recipe.
+    ``"ok"`` requires BOTH cost sources populated and the cross-source
+    flops ratio inside :data:`AGREEMENT_BAND`; anything else is the
+    violation line (the caller counts it as a failure)."""
+    c = getattr(report, "cost", None)
+    lines = []
+    if c is None or c.flops is None:
+        return ("no cost view (neither cost_analysis nor a jaxpr)",
+                lines)
+    rl = roofline(c.flops, c.bytes_accessed, chip=chip)
+    tokens = budget.cost_tokens_per_dispatch
+    lines.append(
+        f"  roofline [{rl.chip.name}]: intensity {rl.intensity:.2f} "
+        f"FLOP/B ({rl.bound}-bound, ridge "
+        f"{rl.chip.ridge_intensity:.0f}), device floor "
+        f"{rl.device_floor_s * 1e6:.2f} us/dispatch")
+    wall = _measured_wall_s(name, tokens)
+    if wall is not None:
+        gap = host_gap_seconds(wall, rl.device_floor_s)
+        lines.append(
+            f"  host gap: measured {wall * 1e6:.1f} us - floor "
+            f"{rl.device_floor_s * 1e6:.2f} us = {gap * 1e6:.1f} us "
+            f"(CPU-smoke wall vs {rl.chip.name} floor: an upper "
+            f"bound, not the TPU gap)")
+    else:
+        lines.append("  host gap: n/a (no measured wall for this "
+                     "recipe in the checked-in bench artifacts)")
+    if c.xla is None:
+        return "cost source missing: no XLA cost_analysis", lines
+    if c.jaxpr is None:
+        return "cost source missing: no jaxpr walk", lines
+    if not c.agreement_ok():
+        return (f"cross-source flops ratio {c.flops_ratio:.3f} outside "
+                f"the pinned band {AGREEMENT_BAND}", lines)
+    return "ok", lines
+
+
+def _report_json(name, report, ok, violations, fp_status=None,
+                 cost_status=None, chip=None):
     out = {
         "recipe": name,
         "budget_ok": ok,
@@ -60,6 +153,24 @@ def _report_json(name, report, ok, violations, fp_status=None):
         }
     if report.sharding is not None:
         out["sharding"] = report.sharding.summary_dict()
+    cost = getattr(report, "cost", None)
+    if cost is not None and cost.source is not None:
+        out["cost"] = {
+            "source": cost.source,
+            "flops": cost.flops,
+            "bytes_accessed": cost.bytes_accessed,
+            "arithmetic_intensity": cost.arithmetic_intensity,
+            "flops_ratio": cost.flops_ratio,
+            "n_partitions": cost.n_partitions,
+        }
+        if chip is not None:
+            rl = roofline(cost.flops, cost.bytes_accessed, chip=chip)
+            out["cost"]["roofline"] = {
+                "chip": rl.chip.name, "bound": rl.bound,
+                "device_floor_us": rl.device_floor_s * 1e6,
+            }
+    if cost_status is not None:
+        out["cost_gate"] = cost_status
     if fp_status is not None:
         out["fingerprint"] = fp_status
     return out
@@ -108,6 +219,15 @@ def main(argv=None):
                          "the new golden (review the git diff!)")
     ap.add_argument("--goldens-dir", default=None,
                     help="golden directory (default: tests/goldens)")
+    ap.add_argument("--cost", action="store_true",
+                    help="print the static cost/roofline table and "
+                         "gate cross-source agreement; exit 1 when a "
+                         "source is missing or the flops ratio leaves "
+                         "the pinned band")
+    ap.add_argument("--chip", default=DEFAULT_CHIP,
+                    choices=sorted(CHIP_SPECS),
+                    help="chip spec for the roofline floor "
+                         f"(default: {DEFAULT_CHIP})")
     ap.add_argument("--json", action="store_true",
                     help="one JSON object per recipe on stdout "
                          "(sorted keys)")
@@ -129,6 +249,13 @@ def main(argv=None):
                     failures += 1
             else:
                 report = recipe.audit()
+
+            cost_status, cost_lines = None, []
+            if args.cost:
+                cost_status, cost_lines = _cost_gate(
+                    name, report, recipe.budget, args.chip)
+                if cost_status != "ok":
+                    failures += 1
 
             fp_status, fp_diff = None, []
             if args.update_goldens:
@@ -152,7 +279,9 @@ def main(argv=None):
                         name, report, ok, violations,
                         fp_status=(fp_status if not fp_diff else
                                    {"status": fp_status,
-                                    "diff": fp_diff})),
+                                    "diff": fp_diff}),
+                        cost_status=cost_status,
+                        chip=args.chip if args.cost else None),
                     sort_keys=True))
             else:
                 print(report.summary())
@@ -161,6 +290,12 @@ def main(argv=None):
                           + ("OK" if ok else "VIOLATED"))
                     for ln in violations:
                         print(f"    ! {ln}")
+                if cost_status is not None:
+                    for ln in cost_lines:
+                        print(ln)
+                    print("  cost gate: "
+                          + ("OK" if cost_status == "ok"
+                             else f"FAILED — {cost_status}"))
                 if fp_status is not None:
                     print(f"  fingerprint: "
                           + ("OK" if fp_status == "ok" else fp_status))

@@ -18,6 +18,7 @@ from .ir import lower_target
 from .collectives import (
     collective_census, reduce_scatter_pattern, COLLECTIVE_KINDS,
 )
+from .cost import analyze_cost
 from .remat import detect_involuntary_remat
 from .dtypes import audit_dtype_promotion, DtypeReport
 from .donation import audit_donation
@@ -36,6 +37,8 @@ _BUDGET_FIELDS = (
     "max_host_callbacks",
     "max_temp_bytes", "max_peak_live_bytes", "max_output_bytes",
     "max_replicated_param_bytes", "min_sharded_params",
+    "max_flops_per_token", "max_hbm_bytes_per_token",
+    "min_arithmetic_intensity", "cost_tokens_per_dispatch",
     "require_donated", "require_reduce_scatter", "require_all_gather",
 )
 
@@ -81,6 +84,20 @@ class Budget:
         max_replicated_param_bytes: no fully-replicated donatable leaf
             (param/state/buffer) above this many bytes — norm scales
             may replicate by design, weight matrices/moments may not.
+        max_flops_per_token / max_hbm_bytes_per_token: per-token cost
+            caps over the static cost model's per-dispatch numbers
+            (:mod:`.cost`, trip-unrolled jaxpr walk preferred) divided
+            by ``cost_tokens_per_dispatch`` — a quantum that starts
+            recomputing prefill work or rematerializing the pool per
+            token blows straight through.
+        min_arithmetic_intensity: FLOP/byte floor for the whole
+            dispatch — positive evidence the program still amortizes
+            its weight traffic over the batched tokens (an intensity
+            collapse means the quantum degraded toward one-token
+            dispatches).
+        cost_tokens_per_dispatch: the token divisor for the two
+            per-token caps (an input, not a cap: how many tokens one
+            dispatch of this recipe emits at full occupancy).
     Requirements:
         min_sharded_params: at least this many donatable leaves carry
             a real (non-replicated) sharding — the ZeRO/TP axis is
@@ -129,7 +146,8 @@ class AuditReport:
     """Structured result of every pass over one compiled program."""
 
     def __init__(self, name, collectives, remat_events, dtype_report,
-                 donation, host_sync=None, memory=None, sharding=None):
+                 donation, host_sync=None, memory=None, sharding=None,
+                 cost=None):
         self.name = name
         #: dict kind -> CollectiveStats
         self.collectives = collectives
@@ -145,6 +163,8 @@ class AuditReport:
         self.memory = memory
         #: ShardingReport (per-arg layouts from StableHLO attrs)
         self.sharding = sharding
+        #: CostReport (XLA cost_analysis + jaxpr FLOP/byte walk)
+        self.cost = cost
 
     @property
     def total_collectives(self):
@@ -199,6 +219,8 @@ class AuditReport:
             s = self.sharding.summary_dict()
             lines.append("  sharding: " + ", ".join(
                 f"{k} {s[k]}" for k in sorted(s)))
+        if self.cost is not None:
+            lines.extend(self.cost.summary_lines())
         return "\n".join(lines)
 
 
@@ -223,9 +245,10 @@ def audit(target, *args, **kwargs):
         lt, donated_indices=[a.index for a in donation.args
                              if a.donated], jaxpr=jaxpr)
     sharding = audit_sharding(stablehlo, n_donatable=lt.n_donatable)
+    cost = analyze_cost(lt, jaxpr=jaxpr)
     report = AuditReport(lt.name, census, remat_events, dtype_report,
                          donation, host_sync=host_sync, memory=memory,
-                         sharding=sharding)
+                         sharding=sharding, cost=cost)
     report.hlo_text = hlo  # kept for pattern checks (reduce-scatter)
     return report
 
@@ -287,6 +310,40 @@ def check_budget(target, budget, *args, **kwargs):
                      "view to measure it")
         else:
             cap(limit, actual, what)
+
+    cost = report.cost
+    cost_caps_set = (budget.max_flops_per_token is not None
+                     or budget.max_hbm_bytes_per_token is not None
+                     or budget.min_arithmetic_intensity is not None)
+    if cost_caps_set:
+        if cost is None or cost.flops is None:
+            v.append("cost budget set but the target offers no cost "
+                     "view (neither cost_analysis nor a jaxpr)")
+        else:
+            tokens = budget.cost_tokens_per_dispatch
+            per_token_set = (budget.max_flops_per_token is not None
+                             or budget.max_hbm_bytes_per_token
+                             is not None)
+            if per_token_set and not tokens:
+                v.append("per-token cost cap set without "
+                         "cost_tokens_per_dispatch (the divisor)")
+            elif per_token_set:
+                fpt, bpt = cost.per_token(tokens)
+                cap(budget.max_flops_per_token, fpt,
+                    f"cost-model flops/token (over {tokens} tokens)")
+                cap(budget.max_hbm_bytes_per_token, bpt,
+                    f"cost-model HBM bytes/token (over {tokens} "
+                    f"tokens)")
+            ai = cost.arithmetic_intensity
+            if budget.min_arithmetic_intensity is not None:
+                if ai is None:
+                    v.append("min_arithmetic_intensity set but byte "
+                             "traffic is unknown")
+                elif ai < budget.min_arithmetic_intensity:
+                    v.append(
+                        f"arithmetic intensity: {ai:.3f} FLOP/B < "
+                        f"budget minimum "
+                        f"{budget.min_arithmetic_intensity}")
 
     sh = report.sharding
     if budget.max_replicated_param_bytes is not None and sh is not None:

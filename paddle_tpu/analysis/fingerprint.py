@@ -3,7 +3,8 @@
 A fingerprint is the canonical, deterministic scalar summary of one
 compiled recipe — collective op counts and byte volumes, involuntary
 remat events, donation coverage, dtype taints, host syncs, both memory
-views, and the sharding layout summary — serialized (sorted keys,
+views, the sharding layout summary, and the static cost model's
+FLOP/byte numbers with their cross-source ratio — serialized (sorted keys,
 stable types) to ``tests/goldens/<recipe>.json``. Tier-1 compares the
 live audit of each registered recipe against its checked-in golden, so
 *any* silent graph drift — an extra collective, a lost donation, a
@@ -102,6 +103,18 @@ def fingerprint_report(report, name=""):
     }
     sh = getattr(report, "sharding", None)
     fp["sharding"] = None if sh is None else sh.summary_dict()
+    cost = getattr(report, "cost", None)
+    fp["cost"] = None if cost is None or cost.source is None else {
+        "source": cost.source,
+        "flops": int(round(cost.flops)),
+        "bytes_accessed": int(round(cost.bytes_accessed)),
+        "transcendentals": int(round(cost.transcendentals)),
+        "n_partitions": cost.n_partitions,
+        # the cross-source agreement, frozen per recipe: a walker or
+        # compiler change that moves it is a reviewable golden diff
+        "flops_ratio": (None if cost.flops_ratio is None
+                        else round(cost.flops_ratio, 3)),
+    }
     return fp
 
 

@@ -43,6 +43,28 @@ the scope's PARAMETERS are the traced values. Rules:
   jax's functional array update ``x.at[i].set(v)`` is recognized and
   exempt.
 
+Rules H108-H110 invert the scope: they scan **host** (non-jit) code
+for *implicit device→host sync escapes* — the silent blocking
+transfers the static cost model's host-gap estimate exists to kill
+(ROADMAP item 2). Host taint seeds are DIRECT jax values (results of
+``jnp.*`` / ``jax.numpy`` / ``jax.random`` / ``jax.lax`` /
+``jax.device_put`` calls), not function parameters and not ``._value``
+reads — the eager Tensor wrapper's contract is host semantics and its
+conversion points are the audited, explicit sync surface:
+
+- **H108 host scalar coercion**: a bare ``.item()`` call (on anything
+  but an explicit ``np``/``numpy`` receiver), or ``float()`` /
+  ``int()`` / ``bool()`` over a jax-tainted value, in host code — each
+  one is a synchronous device round-trip the profiler never sees.
+- **H109 numpy over jax value**: ``np.asarray`` / ``np.array`` / any
+  ``np.*`` call with a jax-tainted argument in host code — an implicit
+  blocking transfer hiding behind a type conversion.
+- **H110 sync barrier in library code**: ``.block_until_ready()`` /
+  ``jax.block_until_ready(...)`` anywhere in a file that is not
+  bench/test code (path has a ``tests`` segment or a ``bench*`` /
+  ``test*`` / ``conftest*`` basename) — a hard device barrier belongs
+  in measurement harnesses, never in the serving/runtime libraries.
+
 Known limits (by design, to stay fast and false-positive-light): the
 scope detection is lexical per module — a module-level helper that is
 only CALLED from inside a jitted closure is not scanned (no
@@ -75,7 +97,17 @@ RULES = {
             "a jit scope — constant-folds into the trace",
     "H107": "metric mutation (.inc/.observe/.set) inside a jit scope — "
             "runs once at trace time, then silently freezes",
+    "H108": "implicit device->host sync in host code (bare .item() or "
+            "float/int/bool over a jax value) — a blocking transfer "
+            "no profiler hook sees",
+    "H109": "np.* over a jax value in host code — an implicit "
+            "device->host transfer hiding behind a type conversion",
+    "H110": "block_until_ready outside bench/test code — a hard "
+            "device-sync barrier in library code",
 }
+
+# host-taint seeds for H108/H109: calls returning jax array values
+_JAX_VALUE_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.", "jax.lax.")
 
 # the obs registry's mutation surface (Counter.inc / Histogram.observe
 # / Gauge.set); `.at[...].set(...)` is jax's functional update, exempt
@@ -473,6 +505,120 @@ class _TaintChecker:
                     self._flag("H103", node, f"{callee}(...)")
 
 
+def _bench_exempt(path):
+    """True for measurement/test code where explicit device syncs are
+    the point: a ``tests`` path segment, or a ``bench*`` / ``test*`` /
+    ``conftest*`` basename (scripts/bench_*.py, repo-root bench.py)."""
+    parts = path.replace(os.sep, "/").split("/")
+    base = parts[-1]
+    return ("tests" in parts[:-1]
+            or base.startswith(("bench", "test", "conftest")))
+
+
+class _HostEscapeChecker(_TaintChecker):
+    """Pass 3 (H108/H109): HOST-side (non-jit) functions, where the
+    hazard inverts — a jax array value coerced to a Python scalar or a
+    numpy array is an implicit blocking device->host transfer. Taint
+    seeds are DIRECT jax values (jnp./jax.numpy/jax.random/jax.lax
+    call results and ``jax.device_put``), not the function's
+    parameters — and deliberately NOT ``._value`` reads: the eager
+    Tensor wrapper's contract IS host semantics, and its conversion
+    points (``Tensor.numpy()``/``.item()``) are the audited, explicit
+    sync surface. These rules exist to catch NEW jnp-direct escapes
+    in runtime code, not to re-litigate the eager API."""
+
+    def __init__(self, path, info):
+        super().__init__(path, info, inherited_taint=())
+        self.taint.clear()  # params are host values here, not tracers
+
+    def _flag(self, rule, node, detail=""):
+        # the inherited statement walk would also emit the jit-scope
+        # rules (H104 on `if jax_value:` etc.); in host code those are
+        # legal — only the escape rules belong to this pass
+        if rule in ("H108", "H109"):
+            super()._flag(rule, node, detail)
+
+    def tainted(self, node):
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func)
+            if callee is not None and (
+                    callee.startswith(_JAX_VALUE_PREFIXES)
+                    or callee == "jax.device_put"):
+                return True
+        return super().tainted(node)
+
+    def _scan_expr(self, expr):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            # H108a: bare .item() — on anything but an explicit numpy
+            # receiver it is a device round-trip (jax arrays and the
+            # eager Tensor wrapper both sync here)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" \
+                    and not node.args and not node.keywords:
+                base = _dotted(node.func.value)
+                if base not in ("np", "numpy"):
+                    self._flag(
+                        "H108", node,
+                        f".item() on "
+                        f"{ast.unparse(node.func.value)[:40]}")
+                continue
+            callee = _dotted(node.func)
+            # H108b: scalar coercion of a jax value
+            if callee in ("float", "int", "bool") and node.args \
+                    and self.tainted(node.args[0]):
+                self._flag(
+                    "H108", node,
+                    f"{callee}({ast.unparse(node.args[0])[:40]})")
+                continue
+            # H109: numpy conversion of a jax value (the conversion
+            # entry points only — np.testing asserts etc. sync too,
+            # but the conversions are the ones that hide in runtime
+            # code paths behind an innocent-looking cast)
+            if callee in ("np.asarray", "np.array", "numpy.asarray",
+                          "numpy.array", "np.ascontiguousarray",
+                          "numpy.ascontiguousarray", "np.copy",
+                          "numpy.copy"):
+                if any(self.tainted(a) for a in node.args) or any(
+                        self.tainted(kw.value)
+                        for kw in node.keywords):
+                    self._flag("H109", node, f"{callee}(...)")
+
+
+def _block_until_ready_violations(path, tree, collector):
+    """H110: any block_until_ready call in a non-bench/test file —
+    jit scope or host, the barrier does not belong in library code."""
+    if _bench_exempt(path):
+        return []
+    out = []
+
+    def visit(node, qual):
+        if not isinstance(node, ast.Call):
+            return
+        hit = (isinstance(node.func, ast.Attribute)
+               and node.func.attr == "block_until_ready")
+        if not hit:
+            callee = _dotted(node.func)
+            hit = callee is not None and _suffix_match(
+                callee, ("jax.block_until_ready",))
+        if hit:
+            out.append(LintViolation(
+                path, "H110", qual, node.lineno,
+                RULES["H110"]
+                + f": {ast.unparse(node.func)[:50]}(...)"))
+
+    def walk(node, qual):
+        for child in ast.iter_child_nodes(node):
+            info = collector.by_node.get(id(child))
+            child_qual = info.qualname if info is not None else qual
+            visit(child, child_qual)
+            walk(child, child_qual)
+
+    walk(tree, "<module>")
+    return out
+
+
 def lint_source(source, path="<string>"):
     """Lint one module's source text; returns [LintViolation]."""
     tree = ast.parse(source, filename=path)
@@ -481,9 +627,13 @@ def lint_source(source, path="<string>"):
     collector.finalize()
 
     violations = _mutable_default_violations(path, collector)
+    violations.extend(_block_until_ready_violations(
+        path, tree, collector))
 
     for info in collector.functions:
         if not info.jit_scoped():
+            checker = _HostEscapeChecker(path, info)
+            violations.extend(checker.run())
             continue
         inherited = set()
         parent = info.parent

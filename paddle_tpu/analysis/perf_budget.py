@@ -432,4 +432,16 @@ def default_perf_budgets():
                    "index-gated submission points) — a router that "
                    "stops spreading load collapses it to 1.0, so no "
                    "noise band"),
+        PerfBudget(
+            "cost-cross-source-agreement", "BENCH_COST_r17.json",
+            "cost_model_cross_source_agreement_cpu_smoke",
+            floor=0.5, ceiling=2.0, noise_frac=0.0,
+            reason="static jaxpr flops over XLA cost_analysis flops "
+                   "on the serving decode quantum (observed 0.98; "
+                   "backend-independent — both sources count the "
+                   "same traced program, so drift means the walker "
+                   "or the graph changed, not the machine; no noise "
+                   "band). Tighter than the coarse per-recipe "
+                   "AGREEMENT_BAND the --cost CLI applies to every "
+                   "recipe including the tpxzero train step"),
     ]

@@ -143,6 +143,15 @@ def _build_llama_tp_zero_fused_lce():
         # 48 sharded leaves audited: params + both moments actually
         # carry the axis, not just the sharding rule table
         min_sharded_params=40,
+        # static cost model (analysis/cost.py): 117.9M flops / 61.5 MB
+        # accessed per step over the 4x32-token batch — ~921k flops
+        # and ~480 KB per token audited; a lost fusion or an
+        # accidental f32 re-materialization of the state blows the
+        # byte cap, a duplicated forward blows the flop cap
+        cost_tokens_per_dispatch=128,
+        max_flops_per_token=1_200_000,
+        max_hbm_bytes_per_token=650_000,
+        min_arithmetic_intensity=1.4,
     )
     return Recipe("llama_tp_zero_fused_lce", step, (ids, ids), budget,
                   teardown=_mesh_teardown())
@@ -182,6 +191,13 @@ def _build_llama_decode_greedy():
         # full-cache copies is a structural regression
         max_temp_bytes=64_000,
         max_output_bytes=1024,
+        # cost model: 2.63M flops / 5.09 MB over the 8 decoded tokens
+        # (329k flops, 636 KB per token audited) — the whole-loop
+        # decode must keep amortizing weight reads across its scan
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=450_000,
+        max_hbm_bytes_per_token=850_000,
+        min_arithmetic_intensity=0.35,
     )
     return Recipe("llama_decode_greedy", jitted, args, budget)
 
@@ -226,6 +242,14 @@ def _build_serving_decode_step():
         # unrolled scan materializing per-token buffers blows this
         max_temp_bytes=300_000,
         max_peak_live_bytes=1_300_000,
+        # cost model: 2.49M flops / 18.8 MB accessed per quantum over
+        # 2 slots x 4 decode steps = 8 tokens (311k flops / 2.36 MB
+        # per token audited; the quantum re-reads the weights each
+        # scan step, hence the deeply memory-bound 0.13 FLOP/B)
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=420_000,
+        max_hbm_bytes_per_token=3_100_000,
+        min_arithmetic_intensity=0.09,
     )
     recipe = Recipe("serving_decode_step", target, args, budget)
     recipe.engine = engine  # obs CLI asserts the instrumented engine
@@ -267,6 +291,13 @@ def _build_speculative_verify_step():
         # pools both in flight; donation saves 402 KB of that)
         max_temp_bytes=330_000,
         max_peak_live_bytes=2_000_000,
+        # cost model: 2.87M flops / 12.8 MB per round over 2 slots x
+        # (gamma+1)=3 tokens = 6 tokens at full acceptance (478k
+        # flops / 2.13 MB per token audited)
+        cost_tokens_per_dispatch=6,
+        max_flops_per_token=640_000,
+        max_hbm_bytes_per_token=2_900_000,
+        min_arithmetic_intensity=0.15,
     )
     recipe = Recipe("speculative_verify_step", step, args, budget)
     recipe.engine = engine  # obs CLI asserts the instrumented engine
@@ -323,6 +354,12 @@ def _build_serving_frontdoor_step():
         # ~30% headroom like the other serving recipes
         max_temp_bytes=280_000,
         max_peak_live_bytes=1_300_000,
+        # cost model: the sampling filter adds ~14k flops to the plain
+        # quantum (2.50M / 19.0 MB over 8 tokens audited) — same caps
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=420_000,
+        max_hbm_bytes_per_token=3_100_000,
+        min_arithmetic_intensity=0.09,
     )
     recipe = Recipe("serving_frontdoor_step", target, args, budget)
     recipe.engine = engine  # obs CLI asserts the instrumented engine
@@ -375,6 +412,13 @@ def _build_serving_prefix_step():
         # change the compiled quantum at all
         max_temp_bytes=300_000,
         max_peak_live_bytes=1_300_000,
+        # cost model: identical numbers to serving_decode_step (2.49M
+        # flops / 18.8 MB over 8 tokens) — the cache must be free in
+        # the compiled program's cost exactly like in its structure
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=420_000,
+        max_hbm_bytes_per_token=3_100_000,
+        min_arithmetic_intensity=0.09,
     )
     recipe = Recipe("serving_prefix_step", target, args, budget)
     recipe.engine = engine  # obs CLI asserts the instrumented engine
@@ -427,6 +471,15 @@ def _build_serving_int8_step():
         # path; same ~30% headroom discipline as the other recipes
         max_temp_bytes=800_000,
         max_peak_live_bytes=450_000,
+        # cost model: 3.09M flops / 22.6 MB over 8 tokens (387k flops
+        # / 2.82 MB per token audited) — the in-graph dequant work
+        # costs ~24% more flops than the bf16 quantum, pinned here so
+        # a silently widening dequant path (per-element f32 blow-up)
+        # cannot ride in under the structural caps
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=520_000,
+        max_hbm_bytes_per_token=3_700_000,
+        min_arithmetic_intensity=0.09,
     )
     recipe = Recipe("serving_int8_step", target, args, budget)
     recipe.engine = engine  # obs CLI asserts the instrumented engine
@@ -484,6 +537,15 @@ def _build_serving_tp_step():
         # serving_decode_step's; same ~30% headroom on both
         max_temp_bytes=180_000,
         max_peak_live_bytes=1_300_000,
+        # cost model: 2.77M flops / 21.1 MB LOGICAL (pre-partitioning
+        # jaxpr) over 8 tokens — the tp collectives add ~11% flops of
+        # in-graph reduction work over the tp1 quantum; per-chip cost
+        # is half (the cross-check scales XLA's per-shard report by
+        # the 2 partitions)
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=460_000,
+        max_hbm_bytes_per_token=3_500_000,
+        min_arithmetic_intensity=0.09,
     )
     recipe = Recipe("serving_tp_step", target, args, budget)
     recipe.engine = engine  # obs CLI asserts the instrumented engine

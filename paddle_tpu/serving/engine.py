@@ -666,6 +666,14 @@ class ServingEngine:
             2-byte floats). Default ``None``: float pools, every
             existing golden byte-identical (the scale tuples are empty
             pytrees — zero extra avals in the quantum signature).
+        cost_model: ``True`` sizes the cost ledger's MFU numerator from
+            the static cost model (:mod:`paddle_tpu.analysis.cost`):
+            the decode quantum's jaxpr-walked FLOPs per token — which
+            counts attention over live context and the lm-head that
+            the ``2N`` weight-matmul floor deliberately excludes —
+            clamped to never fall below that floor. Host-side
+            accounting only; the compiled quantum and its golden are
+            untouched. Default ``False``: the 2N floor, as before.
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
@@ -676,7 +684,7 @@ class ServingEngine:
                  per_request_sampling=False, obs=None,
                  trace=False, slo=None, flight=None, mesh=None, tp=None,
                  faults=None, resilience=None, quantize=None,
-                 kv_dtype=None):
+                 kv_dtype=None, cost_model=False):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -930,9 +938,21 @@ class ServingEngine:
         # int8 flops model: a quantized stack feeds the MXU's int8 path,
         # whose peak is 2x the bf16 peak — the MFU denominator doubles
         # (flops per token is unchanged: same 2N contraction count)
+        flops_tok = decode_flops_per_token(
+            n_params, n_embedding_params=embed)
+        if cost_model:
+            # opt-in: count the ACTUAL decode quantum's jaxpr (attention
+            # over live context + lm-head, which 2N excludes) and take
+            # the larger — the walker returns 0.0 when the quantum
+            # cannot be traced, so the floor always survives
+            try:
+                from ..analysis.cost import quantum_flops_per_token
+
+                flops_tok = max(quantum_flops_per_token(self), flops_tok)
+            except Exception:
+                pass
         self.obs.ledger.configure(
-            flops_per_token=decode_flops_per_token(
-                n_params, n_embedding_params=embed),
+            flops_per_token=flops_tok,
             peak_flops=peak_flops_per_chip()
             * (2.0 if quantize is not None else 1.0))
         # SLO + flight recorder (the operability tier over the obs

@@ -1,0 +1,190 @@
+"""Cost-model bench (ISSUE 16): predicted roofline floor vs measured
+dispatch wall for the single-chip audited recipes.
+
+    JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/bench_cost.py
+
+For each single-chip recipe the static cost model (analysis/cost.py)
+predicts the DEVICE-TIME FLOOR on the default chip spec —
+``max(flops/peak, bytes/bw)`` from the jaxpr-walked FLOP/byte counts —
+and the bench measures the actual per-dispatch wall in-process
+(warmup + timed iterations, ``block_until_ready``; buffer donation is
+not enforced on the CPU backend, so re-dispatching the same args is
+sound for timing). The HOST GAP column (wall - floor) is a CPU wall
+against a TPU-spec floor: an upper bound on the dispatch overhead a
+device run could hide behind, NOT a TPU claim — the floors become
+testable on hardware, the agreement ratio is testable everywhere.
+
+One extra row pins the CROSS-SOURCE AGREEMENT on the serving decode
+quantum — static jaxpr flops over XLA ``cost_analysis()`` flops — the
+ratio the `--cost` CLI gates per-recipe and perf budget
+``cost-cross-source-agreement`` guards in BENCH_COST_r17.json
+(backend-independent: the walker counts the traced program, so the
+ratio moves only when the graph or the walker changes).
+
+The mesh recipes (tp2 x zero4 train, tp2 serving) are audited by
+`--cost` but not timed here: their 8-virtual-device dispatch walls on
+one CPU measure contention, not dispatch overhead.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu import analysis  # noqa: E402
+from paddle_tpu.analysis.cost import DEFAULT_CHIP, roofline  # noqa: E402
+
+#: recipes timed here: single-chip quanta whose dispatch wall on one
+#: CPU is a meaningful (if noisy) per-dispatch overhead measurement
+TIMED_RECIPES = (
+    "llama_decode_greedy",
+    "serving_decode_step",
+    "speculative_verify_step",
+    "serving_int8_step",
+)
+
+WARMUP = 2
+ITERS = 10
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _time_dispatch(target, args):
+    """Median per-dispatch wall seconds over ITERS timed calls.
+
+    The quanta donate their leading pool args, so every call consumes
+    its inputs — snapshot the example args to host ONCE, then upload a
+    fresh device copy per call OUTSIDE the timed window (the timed
+    region is dispatch + compute only, matching what the roofline
+    floor models)."""
+    import numpy as np
+
+    snapshot = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+        args)
+
+    def fresh():
+        a = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x) if isinstance(x, np.ndarray)
+            else x, snapshot)
+        jax.block_until_ready(a)
+        return a
+
+    for _ in range(WARMUP):
+        jax.block_until_ready(target(*fresh()))
+    walls = []
+    for _ in range(ITERS):
+        a = fresh()
+        t0 = time.perf_counter()
+        jax.block_until_ready(target(*a))
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]
+
+
+def _recipe_row(name, chip=DEFAULT_CHIP):
+    recipe = analysis.build_recipe(name)
+    try:
+        report = recipe.audit()
+        c = report.cost
+        rl = roofline(c.flops, c.bytes_accessed, chip=chip)
+        wall = _time_dispatch(recipe.target, recipe.args)
+    finally:
+        recipe.close()
+    floor_us = rl.device_floor_s * 1e6
+    wall_us = wall * 1e6
+    return {
+        "metric": "cost_model_floor_vs_measured_cpu_smoke",
+        "recipe": name,
+        "value": round(wall_us / floor_us, 1),
+        "unit": f"measured cpu wall / {rl.chip.name} floor "
+                f"(dispatch-overhead upper bound, not a TPU claim)",
+        "measured_us_per_dispatch": round(wall_us, 1),
+        "predicted_floor_us": round(floor_us, 2),
+        "host_gap_us_upper_bound": round(wall_us - floor_us, 1),
+        "chip": rl.chip.name,
+        "bound": rl.bound,
+        "arithmetic_intensity": round(rl.intensity, 3),
+        "flops_per_dispatch": c.flops,
+        "hbm_bytes_per_dispatch": c.bytes_accessed,
+        "cost_source": c.source,
+        "flops_ratio_jaxpr_over_xla": (
+            round(c.flops_ratio, 3) if c.flops_ratio else None),
+        "warmup": WARMUP, "iters": ITERS,
+    }
+
+
+def _agreement_row():
+    recipe = analysis.build_recipe("serving_decode_step")
+    try:
+        c = recipe.audit().cost
+    finally:
+        recipe.close()
+    return {
+        "metric": "cost_model_cross_source_agreement_cpu_smoke",
+        "value": round(c.flops_ratio, 3),
+        "unit": "jaxpr-static flops / xla cost_analysis flops "
+                "(serving decode quantum)",
+        "recipe": "serving_decode_step",
+        "band_lo": analysis.AGREEMENT_BAND[0],
+        "band_hi": analysis.AGREEMENT_BAND[1],
+        "n_partitions": c.n_partitions,
+    }
+
+
+def cost_rows():
+    rows = []
+    for name in TIMED_RECIPES:
+        log(f"  timing {name} ...")
+        rows.append(_recipe_row(name))
+    rows.append(_agreement_row())
+    return rows
+
+
+def cost_model():
+    """bench_suite entry: the guarded agreement row, with the per-
+    recipe floor-vs-measured summary folded in as extra fields."""
+    rows = cost_rows()
+    head = rows[-1]
+    for r in rows[:-1]:
+        key = r["recipe"]
+        head[f"{key}_measured_us"] = r["measured_us_per_dispatch"]
+        head[f"{key}_floor_us"] = r["predicted_floor_us"]
+    return head
+
+
+def main():
+    out = {
+        "round": "PR17",
+        "cmd": "JAX_PLATFORMS=cpu PYTHONPATH=. python "
+               "scripts/bench_cost.py",
+        "device": "cpu (JAX_PLATFORMS=cpu smoke; floors are "
+                  f"{DEFAULT_CHIP} TPU-spec predictions — the "
+                  "wall/floor ratio is a dispatch-overhead upper "
+                  "bound, the agreement ratio is backend-independent)",
+        "note": "Static cost model & roofline sentinel (ISSUE 16): "
+                "jaxpr-walked FLOP/byte counts cross-checked against "
+                "XLA cost_analysis, device-time floors from the chip "
+                "spec table, measured single-chip dispatch walls for "
+                "the host-gap column of `python -m paddle_tpu."
+                "analysis --cost`. See BENCH_NOTES.md cost section.",
+        "rows": cost_rows(),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_COST_r17.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=False)
+        f.write("\n")
+    log(f"wrote {path}")
+    print(json.dumps(out["rows"][-1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,8 @@ line per config; results are recorded in BENCH_NOTES.md.
 
     PYTHONPATH=. python scripts/bench_suite.py [config ...]
 
-Configs: graph_audit | graph_fingerprint | resnet50_eager |
+Configs: graph_audit | graph_fingerprint | cost_model |
+resnet50_eager |
 resnet50_jit | gpt2_jit | ernie_engine |
 sd_unet | llama_decode | llama_941m_decode_int8 | llama_941m_train |
 llama_941m_packed_train | llama_7b_shape_train |
@@ -931,6 +932,22 @@ def graph_fingerprint():
             "unit": "recipes"}
 
 
+def cost_model():
+    """Static cost model vs reality (ISSUE 16): roofline floors vs
+    measured single-chip dispatch walls plus the guarded cross-source
+    flops-agreement ratio (see scripts/bench_cost.py and
+    BENCH_COST_r17.json)."""
+    import os
+    import sys as _sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if here not in _sys.path:
+        _sys.path.insert(0, here)
+    import bench_cost
+
+    return bench_cost.cost_model()
+
+
 def _bench_serving():
     """Import scripts/bench_serving.py wherever the suite is run from
     (same trick as _bench for the repo-root driver)."""
@@ -1055,6 +1072,7 @@ def serving_cluster():
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
+    "cost_model": cost_model,
     "serving_engine": serving_engine,
     "speculative_decode": speculative_decode,
     "speculative_serving": speculative_serving,

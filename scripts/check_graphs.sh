@@ -15,8 +15,8 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}."
 
-python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/
-python -m paddle_tpu.analysis --check --fingerprint
+python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/ tests/
+python -m paddle_tpu.analysis --check --fingerprint --cost
 # Observability gate (ISSUE 5 + 6): rebuild the serving + speculative
 # recipes — whose engines run with FULL instrumentation (metrics
 # registry + request tracer + SLOs + flight recorder) — and assert
@@ -82,6 +82,17 @@ python -m paddle_tpu.analysis --check --fingerprint
 # bit-identical to the unshared int8 engine, a >=2x pool-residency
 # win over the float twin, and the dtype-labeled serving_pool_bytes
 # gauge live in the registry.
+#
+# Cost-model gate (ISSUE 16): the lint scan above now covers tests/
+# and the host-escape rules H108-H110 (implicit device->host syncs in
+# HOST code: bare .item(), float()/np.* over jax values,
+# block_until_ready outside bench/test paths) with a justified-only
+# allowlist; `--cost` prints each recipe's FLOP/byte counts, roofline
+# placement and device-time floor on the default chip, and gates that
+# BOTH cost sources (XLA cost_analysis + the jaxpr walker) populated
+# and agree within the pinned band. The per-recipe FLOP/byte/intensity
+# caps ride `--check`; the exact counts ride the goldens; the
+# cross-source ratio is also budget-guarded in BENCH_COST_r17.json.
 #
 # Cluster gate (ISSUE 15): the router is pure host code riding the
 # same engines, so `--check --fingerprint` above (0 host callbacks,

@@ -1,8 +1,10 @@
 """paddle_tpu.analysis.lint — tracer-hazard AST linter.
 
 Rule-level tests run the linter over synthetic known-bad/known-clean
-sources; the REPO GATE runs it over the ``paddle_tpu/`` tree AND the
-``scripts/`` bench drivers with the checked-in allowlist, so any new
+sources; the REPO GATE runs it over the ``paddle_tpu/`` tree, the
+``scripts/`` bench drivers AND ``tests/`` (the host-escape rules
+H108-H110 apply everywhere; deliberate test sync idioms carry
+justified allowlist entries) with the checked-in allowlist, so any new
 host sync, traced-value branch, np.-on-tensor, or mutable default
 introduced by a future PR fails tier-1 — and stale allowlist entries
 fail it too (CLI default since the fingerprint PR), so the list can
@@ -211,17 +213,139 @@ def test_allowlist_requires_justification(tmp_path):
         load_allowlist(str(allow))
 
 
+HOST_ESCAPE_SOURCE = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def pump(logits):
+    probs = jnp.exp(logits)        # jax value born on device
+    peak = float(jnp.max(probs))   # H108: blocking host cast
+    host = np.asarray(probs)       # H109: transfer behind a conversion
+    tok = logits.item()            # H108: bare .item()
+    return peak, host, tok
+
+def clean_host(batch, t):
+    # plain-numpy host math and the eager wrapper's OWN conversion
+    # surface: neither involves a direct jax value
+    arr = np.asarray(batch)
+    total = float(np.sum(arr))
+    host = np.asarray(t.numpy())
+    return total, host
+'''
+
+
+def test_h108_h109_host_escapes():
+    """ISSUE 16: implicit device->host syncs in HOST code — bare
+    .item(), float()/int()/bool() over a jax value, np.* conversions
+    over a jax value — are escapes no profiler hook sees."""
+    vs = lint_source(HOST_ESCAPE_SOURCE, "m.py")
+    assert [(v.rule, v.qualname) for v in vs] == [
+        ("H108", "pump"), ("H109", "pump"), ("H108", "pump")]
+
+
+def test_h108_taint_propagates_through_assignment():
+    src = '''
+import jax.numpy as jnp
+
+def score(x):
+    y = jnp.dot(x, x)
+    z = y + 1
+    return int(z)          # H108: z is jax-born two hops back
+'''
+    vs = lint_source(src, "m.py")
+    assert [v.rule for v in vs] == ["H108"]
+
+
+def test_h108_parameters_are_not_seeds():
+    """Function parameters are NOT taint seeds for the host rules —
+    the eager Tensor wrapper's contract IS host semantics, and its
+    audited conversion points would otherwise drown the signal."""
+    src = '''
+import numpy as np
+
+def eager_op(t):
+    return float(np.asarray(t).sum())
+'''
+    assert lint_source(src, "m.py") == []
+
+
+H110_SOURCE = '''
+import jax
+
+def drain(engine):
+    out = engine.step()
+    out.block_until_ready()      # H110: hard barrier in library code
+    jax.block_until_ready(out)   # H110: functional form
+    return out
+'''
+
+
+def test_h110_block_until_ready_in_library_code():
+    vs = lint_source(H110_SOURCE, "paddle_tpu/serving/foo.py")
+    assert [(v.rule, v.qualname) for v in vs] == [
+        ("H110", "drain"), ("H110", "drain")]
+
+
+@pytest.mark.parametrize("path", [
+    "tests/test_foo.py", "scripts/bench_foo.py", "conftest.py"])
+def test_h110_bench_and_test_paths_exempt(path):
+    """block_until_ready is the JOB of bench timing loops and test
+    parity asserts — those paths are exempt by construction."""
+    assert lint_source(H110_SOURCE, path) == []
+
+
+def test_seeded_engine_pump_sync_caught_and_budget_independent():
+    """Acceptance criterion: a `.item()` slipped into the serving
+    engine's pump path is caught by the LINT layer, while the compiled
+    quantum's host-callback budget (golden pins zero callbacks) is
+    untouched by the mutation — the two gates guard independent
+    layers, so this must NOT rely on the budget to catch it."""
+    import json as _json
+
+    rel = os.path.join("paddle_tpu", "serving", "engine.py")
+    with open(os.path.join(REPO, rel)) as f:
+        src = f.read()
+
+    # the unmutated pump is clean of H108 on step()
+    key = rel.replace(os.sep, "/") + ":H108:step"
+    assert not any(v.rule == "H108" and v.qualname == "step"
+                   for v in lint_source(src, rel))
+    allow = (load_allowlist(DEFAULT_ALLOWLIST)
+             if os.path.exists(DEFAULT_ALLOWLIST) else {})
+    assert key not in allow, "seeded-mutation key must never be allowlisted"
+
+    marker = "    def step(self):"
+    assert marker in src
+    mutated = src.replace(
+        marker,
+        marker + "\n        _seed = self.stats.get('steps').item()",
+        1)
+    vs = lint_source(mutated, rel)
+    assert any(v.rule == "H108" and v.qualname == "step" for v in vs), (
+        "lint layer failed to catch the seeded .item() in the pump")
+
+    # independence: the source mutation never reaches the compiled
+    # quantum, whose golden fingerprint pins zero host callbacks
+    golden = os.path.join(REPO, "tests", "goldens",
+                          "serving_decode_step.json")
+    with open(golden) as f:
+        fp = _json.load(f)
+    assert fp["host_sync"]["callbacks"] == []
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_source_is_tracer_hazard_free():
-    """Tier-1 gate: `paddle_tpu/` AND `scripts/` must lint clean
-    modulo the checked-in allowlist, and the allowlist must carry no
-    stale entries."""
+    """Tier-1 gate: `paddle_tpu/`, `scripts/` AND `tests/` must lint
+    clean modulo the checked-in allowlist, and the allowlist must
+    carry no stale entries."""
     allow = (load_allowlist(DEFAULT_ALLOWLIST)
              if os.path.exists(DEFAULT_ALLOWLIST) else {})
     violations, unused = lint_paths(
         [os.path.join(REPO, "paddle_tpu"),
-         os.path.join(REPO, "scripts")], allow, root=REPO)
+         os.path.join(REPO, "scripts"),
+         os.path.join(REPO, "tests")], allow, root=REPO)
     assert not violations, (
         "new tracer hazards in framework source (fix them or add a "
         "JUSTIFIED allowlist entry):\n  "
@@ -232,11 +356,11 @@ def test_repo_source_is_tracer_hazard_free():
 @pytest.mark.parametrize("extra", [[], ["--strict-allowlist"]])
 def test_lint_cli_exits_zero_on_repo(extra):
     """The acceptance-criteria contract:
-    `python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/`
+    `python -m paddle_tpu.analysis.lint paddle_tpu/ scripts/ tests/`
     exits 0."""
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.analysis.lint",
-         "paddle_tpu/", "scripts/"] + extra,
+         "paddle_tpu/", "scripts/", "tests/"] + extra,
         cwd=REPO, capture_output=True, text=True, timeout=240,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
