@@ -54,6 +54,10 @@ _MEASURED_WALL_SOURCES = {
         "BENCH_SERVING_r06.json",
         "serving_engine_ragged_tokens_per_sec_cpu_smoke",
         "quantum_decode_tokens_per_sec"),
+    "serving_multiquantum_step": (
+        "BENCH_HOSTGAP_r18.json",
+        "serving_hostgap_k16_over_k1_host_us_per_token_cpu_smoke",
+        "fused_quantum_tokens_per_sec"),
 }
 
 
@@ -79,10 +83,13 @@ def _measured_wall_s(name, tokens):
     artifact, metric, field = src
     try:
         with open(os.path.join(_REPO_ROOT, artifact)) as f:
-            for row in json.load(f).get("rows", []):
-                if row.get("metric") == metric and isinstance(
-                        row.get(field), (int, float)) and row[field] > 0:
-                    return tokens / row[field]
+            doc = json.load(f)
+        # rows-style artifact or a flat single-row bench line
+        rows = doc.get("rows", [doc] if "metric" in doc else [])
+        for row in rows:
+            if row.get("metric") == metric and isinstance(
+                    row.get(field), (int, float)) and row[field] > 0:
+                return tokens / row[field]
     except (OSError, ValueError):
         pass
     return None

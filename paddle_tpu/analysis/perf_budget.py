@@ -433,6 +433,17 @@ def default_perf_budgets():
                    "stops spreading load collapses it to 1.0, so no "
                    "noise band"),
         PerfBudget(
+            "host-gap-fraction", "BENCH_HOSTGAP_r18.json",
+            "serving_hostgap_k16_over_k1_host_us_per_token_cpu_smoke",
+            ceiling=0.8, noise_frac=0.1,
+            reason="per-token host-boundary cost at K=16 on-device "
+                   "quanta per dispatch over K=1 must collapse "
+                   "(observed 0.50x: one admission scan + table "
+                   "pre-growth + dispatch amortizes over 16 quanta); "
+                   "ceiling 0.8 leaves headroom over the observed "
+                   "collapse while a driver that silently re-enters "
+                   "the host per quantum decays it to 1.0 and trips"),
+        PerfBudget(
             "cost-cross-source-agreement", "BENCH_COST_r17.json",
             "cost_model_cross_source_agreement_cpu_smoke",
             floor=0.5, ceiling=2.0, noise_frac=0.0,

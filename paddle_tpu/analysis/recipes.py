@@ -552,6 +552,70 @@ def _build_serving_tp_step():
     return recipe
 
 
+def _build_serving_multiquantum_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..nlp import LlamaConfig, LlamaForCausalLM
+    from ..serving import FaultInjector, ServingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    # the MULTI-QUANTUM while_loop driver (K=4 quanta per dispatch)
+    # with the FUSED online-softmax paged-attention inner loop — the
+    # PR-18 host-gap variant, audited under the same full
+    # instrumentation + disarmed-injector + resilience build as
+    # serving_decode_step: 0 host callbacks proves the whole K-quantum
+    # loop (retirement masks, early all-done exit, token buffer) stays
+    # on device, and the golden pins BOTH the while_loop driver and
+    # the fused attention graph. The gather-path recipes above are the
+    # parity oracle and must stay byte-identical.
+    engine = ServingEngine(model, num_slots=2, block_size=4,
+                           prefill_chunk=8, decode_quantum=4,
+                           multi_quantum=4, attn_impl="fused",
+                           trace=True, slo=True, flight=True,
+                           faults=FaultInjector(seed=0),
+                           resilience=True)
+    rng = np.random.RandomState(0)
+    engine.submit(rng.randint(1, cfg.vocab_size, 6).astype(np.int32),
+                  max_new_tokens=8)
+    engine.step()  # admit + prefill so the audited state is live
+    target, args = engine.multiquantum_step_target()
+    budget = Budget(
+        name="serving multi-quantum driver (K=4, fused attn, bf16)",
+        max_remat=0,
+        max_total_collectives=0,  # single-chip serving program
+        max_f32_matmuls=0,        # bf16 pool/params stay bf16
+        max_host_callbacks=0,     # K quanta, ZERO host re-entries
+        require_donated=True,     # the 2L KV pool leaves
+        # audited 7.4 KB temp / 891 KB trace peak: the fused attention
+        # streams pool blocks through running (m, l, acc) statistics
+        # instead of materializing the gathered context — the gather
+        # quantum audits 207 KB temp, so this cap IS the fused win's
+        # structural pin (a fallback to the gather path blows it 17x)
+        max_temp_bytes=12_000,
+        max_peak_live_bytes=1_300_000,
+        # cost model: both walkers count the while_loop body ONCE, so
+        # per-token FLOPs stay comparable to serving_decode_step's
+        # one-quantum dispatch (2 slots x 4 steps = 8 tokens; audited
+        # 329k flops/token — the online softmax adds rescale
+        # elementwise + transcendentals over the one-shot softmax).
+        # The BYTES number is a known jaxpr-walker artifact: the
+        # block-scan charges every step its whole gathered operands
+        # (pool + weights re-counted per block step — 10.7 MB/token
+        # audited), while XLA's compiled report reads 717 KB for the
+        # whole dispatch; the cap pins the walker's shape, not real
+        # HBM traffic (BENCH_NOTES dispatch-decomposition section)
+        cost_tokens_per_dispatch=8,
+        max_flops_per_token=420_000,
+        max_hbm_bytes_per_token=13_000_000,
+        min_arithmetic_intensity=0.025,
+    )
+    recipe = Recipe("serving_multiquantum_step", target, args, budget)
+    recipe.engine = engine  # obs CLI asserts the instrumented engine
+    return recipe
+
+
 RECIPES = {
     "llama_tp_zero_fused_lce": _build_llama_tp_zero_fused_lce,
     "llama_decode_greedy": _build_llama_decode_greedy,
@@ -561,6 +625,7 @@ RECIPES = {
     "serving_prefix_step": _build_serving_prefix_step,
     "serving_int8_step": _build_serving_int8_step,
     "serving_tp_step": _build_serving_tp_step,
+    "serving_multiquantum_step": _build_serving_multiquantum_step,
 }
 
 

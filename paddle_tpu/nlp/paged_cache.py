@@ -256,6 +256,25 @@ class PagedKVCachePool:
         self._peak_blocks = max(self._peak_blocks, self.blocks_in_use)
         return table
 
+    def grow_decode_table(self, seq_id, need_tokens, written_tokens,
+                          pad_to=None, cow=False):
+        """Decode-dispatch pre-growth fused into ONE allocator call:
+        grow ``seq_id``'s table to cover ``need_tokens`` (a K-quantum
+        dispatch pre-grows K*T tokens ahead — admission already
+        reserved the request's worst case, so K-wide growth can never
+        oversubscribe the pool), copy-on-write the about-to-be-written
+        range ``[written_tokens, need_tokens)`` when ``cow`` (prefix-
+        cache engines must never write into a block another holder
+        still maps), and return the padded host int32 table row the
+        quantum dispatch feeds the device."""
+        if need_tokens > self.seq_len(seq_id):
+            self.ensure(seq_id, need_tokens)
+        if cow:
+            self.make_writable(seq_id, int(written_tokens),
+                               int(need_tokens))
+        return np.asarray(self.block_table_array(
+            [seq_id], pad_to=pad_to))[0]
+
     def share(self, src_seq_id, dst_seq_id):
         """Alias ``src``'s blocks into a new table for ``dst`` with the
         refcounts bumped — the content-reuse primitive (prefix cache /

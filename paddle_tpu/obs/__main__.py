@@ -253,7 +253,8 @@ def _cmd_watch(args):
 
 _CHECK_RECIPES = ("serving_decode_step", "speculative_verify_step",
                   "serving_frontdoor_step", "serving_prefix_step",
-                  "serving_int8_step", "serving_tp_step")
+                  "serving_int8_step", "serving_tp_step",
+                  "serving_multiquantum_step")
 
 _REEXEC_GUARD = "_PADDLE_TPU_OBS_REEXEC"
 

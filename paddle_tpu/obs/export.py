@@ -531,5 +531,10 @@ def render_dashboard(snapshot, report=None, width=62):
             f"bal {by_reason.get('balance', 0):>4.0f}, "
             f"fo {by_reason.get('failover', 0):>3.0f})  "
             f"handoffs {handoffs:>3.0f}  hit {hit_rate:6.1%}")
+        host_gap = _snap_sum(snapshot, "serving_host_gap_fraction")
+        if host_gap:
+            lines.append(
+                f" host gap  {host_gap:6.1%} of decode dispatch wall "
+                f"spent host-side (multi-quantum collapses this)")
     lines.append(bar)
     return "\n".join(lines) + "\n"

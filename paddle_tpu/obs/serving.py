@@ -188,6 +188,11 @@ class ServingObs:
         self._g_rate = r.gauge(
             "serving_tokens_per_second_window",
             "generated tok/s over the trailing window")
+        self._g_hostgap = r.gauge(
+            "serving_host_gap_fraction",
+            "host wall minus device wall over quantum wall at the "
+            "decode dispatch boundary (the multi-quantum driver's "
+            "headline: collapses as K grows)")
         self._g_accept = r.gauge(
             "serving_spec_acceptance_rate",
             "per-round accepted/proposed")
@@ -540,16 +545,25 @@ class ServingObs:
             self._g_coll_bytes.set(float(d["bytes"]), kind=kind)
             self._g_coll_count.set(float(d["count"]), kind=kind)
 
-    def on_quantum(self, kind, t0, t1, tokens, rows, breakdown=None):
+    def on_quantum(self, kind, t0, t1, tokens, rows, breakdown=None,
+                   device_s=None):
         """One dispatch boundary: ``kind`` is ``mixed`` (chunked
         prefill + decode rows through block_mha), ``decode`` (the
         jitted quantum) or ``spec_round``; ``tokens`` is how many
         tokens the dispatch appended to request streams. A mixed step
         passes ``breakdown`` (prefill/decode emission split + novel vs
         recompute work tokens) for the cost ledger's phase
-        attribution."""
+        attribution. ``device_s`` (decode quanta) is the measured
+        device-side share of this quantum's wall — dispatch-return to
+        sync-complete, the same decomposition analysis.cost's
+        ``host_gap_seconds`` estimates statically — and refreshes the
+        ``serving_host_gap_fraction`` gauge (this module never imports
+        jax, so the split is measured by the engine and handed in)."""
         if not self.enabled:
             return
+        wall = t1 - t0
+        if device_s is not None and wall > 0.0:
+            self._g_hostgap.set(max(wall - device_s, 0.0) / wall)
         self._h_quantum.observe(t1 - t0, kind=kind)
         self._cum_tokens += int(tokens)
         self._window.append((t1, self._cum_tokens))

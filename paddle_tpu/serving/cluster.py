@@ -440,12 +440,20 @@ class ClusterFrontDoor:
 
     # -- the pump ----------------------------------------------------------
     def pump(self):
-        """One iteration of EVERY replica's front door; True while any
-        replica still has work."""
-        alive = False
+        """One iteration of EVERY replica's front door — OVERLAPPED:
+        dispatch every replica's quantum first (JAX dispatch is async,
+        so each device starts executing immediately), then collect in
+        the same order. N replica devices run concurrently under one
+        pump pass instead of each replica's host work serializing on
+        the previous replica's device wall. True while any replica
+        still has work."""
+        pend = []
         for rep in self.replicas:
             if rep.engine.has_work:
-                alive = rep.door.pump() or alive
+                pend.append((rep, rep.door.pump_dispatch()))
+        alive = False
+        for rep, p in pend:
+            alive = rep.door.pump_collect(p) or alive
         return alive
 
     @property
@@ -463,9 +471,14 @@ class ClusterFrontDoor:
     def drain(self, flight_dir=None):
         """Coordinated drain: every door stops accepting FIRST (so a
         submission racing the drain sheds everywhere instead of
-        landing on a not-yet-draining replica), then each replica
-        finishes everything it accepted. Returns per-replica
-        summaries + fleet totals."""
+        landing on a not-yet-draining replica), then the whole fleet
+        pumps INTERLEAVED until idle — one overlapped pass per replica
+        per round via :meth:`pump`, never one replica to completion
+        before the next starts (the old ring-order drain starved later
+        replicas: replica 0 ran its whole backlog while replica N-1's
+        accepted requests aged). Each door's ``drain()`` then runs on
+        an already-idle engine, contributing only its summary + flight
+        flush. Returns per-replica summaries + fleet totals."""
         import os
         self._draining = True
         for rep in self.replicas:       # flip all gates before pumping
@@ -475,6 +488,8 @@ class ClusterFrontDoor:
                 eng.obs.on_drain(eng.obs.now(),
                                  live=len(eng.scheduler.live()),
                                  waiting=len(eng.scheduler.waiting))
+        while self.has_work:            # interleaved, fleet-wide
+            self.pump()
         out = {"drained": True, "replicas": {}}
         completed = shed = 0
         for rep in self.replicas:

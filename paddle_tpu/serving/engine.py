@@ -266,6 +266,73 @@ def _xla_paged_decode_attn(q, kp, vp, tables, lens, ks=None, vs=None):
     return out.astype(q.dtype)
 
 
+def _fused_paged_decode_attn(q, kp, vp, tables, lens, ks=None, vs=None):
+    """Fused (flash-style) decode attention over the paged pool: an
+    online-softmax scan over the BLOCK-TABLE entries, porting the two
+    tricks the Pallas paged kernel and the d128 varlen retune already
+    won (BENCH_NOTES "Paged KV-cache decode" / "flash/varlen kernel
+    retune") to the portable XLA level:
+
+      * no gathered copy — the oracle (`_xla_paged_decode_attn`)
+        materializes the whole (S, W*BS, HK, D) context twice before a
+        full-width softmax; here each scan step touches ONE pool block
+        per row and folds it into running (m, l, acc) f32 statistics,
+        so temp residency is per-block, not per-context.
+      * DMA elision analog — a row whose context ended before block
+        ``ki`` re-points its gather at pool block 0 (the Pallas
+        kernel's clamped ``pool_idx`` map) and masks the whole block,
+        so dead steps never touch cold pool memory.
+
+    Same f32 compute dtype, same -1e30 mask, same trailing cast as the
+    oracle; the online rescale chain reorders the softmax reductions,
+    which is exactly why the gather path stays wired in as the parity
+    oracle (streams compare bit-exact on the tiny recipe shapes — the
+    bf16 output cast absorbs the ulp-level reassociation).
+    ``ks``/``vs`` are the int8 pool's per-row scale pools: blocks
+    dequantize in f32 as they stream through, never all at once."""
+    s_, h, d = q.shape
+    w = tables.shape[1]
+    bs, hk = kp.shape[1], kp.shape[2]
+    rep = h // hk
+    sc = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)                        # (S, H, D)
+    neg = jnp.float32(-1e30)
+
+    def body(carry, ki):
+        m, l, acc = carry
+        start = ki * bs
+        alive = start < lens                          # (S,)
+        blk = jnp.where(alive, tables[:, ki], 0)      # elision clamp
+        k = kp[blk].astype(jnp.float32)               # (S, BS, HK, D)
+        v = vp[blk].astype(jnp.float32)
+        if ks is not None:
+            k = k * ks[blk][..., None]
+            v = v * vs[blk][..., None]
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        logits = jnp.einsum("bhd,bkhd->bhk", qf, k) * sc   # (S, H, BS)
+        mask = alive[:, None] & (
+            (start + jnp.arange(bs))[None, :] < lens[:, None])
+        logits = jnp.where(mask[:, None, :], logits, neg)
+        m2 = jnp.maximum(m, jnp.max(logits, axis=-1))
+        alpha = jnp.exp(m - m2)                       # (S, H)
+        p = jnp.exp(logits - m2[..., None])           # (S, H, BS)
+        l2 = l * alpha + jnp.sum(p, axis=-1)
+        acc2 = acc * alpha[..., None] + jnp.einsum("bhk,bkhd->bhd", p, v)
+        return (m2, l2, acc2), None
+
+    m0 = jnp.full((s_, h), neg, jnp.float32)
+    l0 = jnp.zeros((s_, h), jnp.float32)
+    a0 = jnp.zeros((s_, h, d), jnp.float32)
+    # every row attends >= 1 position (masked rows carry lens == 1), so
+    # the first live block always lifts m above the -1e30 init before
+    # any dead block's exp(neg - m) underflows to an exact 0
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), jnp.arange(w))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
 def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens, ks=None, vs=None):
     """Chunked decode attention over the paged pool (the speculative
     VERIFY pass): query position j of each slot attends pool positions
@@ -297,12 +364,17 @@ def _xla_paged_chunk_attn(q, kp, vp, tables, base_lens, ks=None, vs=None):
     return out.astype(q.dtype)
 
 
-def _paged_attn(q, kp, vp, tables, lens, ks=None, vs=None):
+def _paged_attn(q, kp, vp, tables, lens, ks=None, vs=None,
+                impl="gather"):
     """Route decode attention: Pallas paged kernel on TPU (block tables
     dereferenced in SMEM, one pool block DMA per grid step), XLA gather
     fallback elsewhere. Per-row scale pools (int8 engine) always take
-    the XLA path: the Pallas kernel only supports STATIC per-head
-    scales, not per-(block, position, head) pools."""
+    an XLA path: the Pallas kernel only supports STATIC per-head
+    scales, not per-(block, position, head) pools. ``impl="fused"``
+    selects the online-softmax block-streaming path
+    (`_fused_paged_decode_attn`) for the XLA tier — the engine's
+    ``attn_impl=`` knob; the default keeps every existing graph (and
+    golden fingerprint) byte-identical."""
     from ..core.flags import get_flags
 
     if ks is None:
@@ -314,6 +386,9 @@ def _paged_attn(q, kp, vp, tables, lens, ks=None, vs=None):
             from ..ops.pallas.paged_attention import paged_decode_attention
 
             return paged_decode_attention(q, kp, vp, tables, lens)
+    if impl == "fused":
+        return _fused_paged_decode_attn(q, kp, vp, tables, lens,
+                                        ks=ks, vs=vs)
     return _xla_paged_decode_attn(q, kp, vp, tables, lens, ks=ks, vs=vs)
 
 
@@ -342,7 +417,7 @@ def _pin_kv_scale(arr):
 
 
 def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
-                      kc, vc, live, ks=(), vs=()):
+                      kc, vc, live, ks=(), vs=(), attn_impl="gather"):
     """One token for every slot over a paged pool (the quantum's
     per-step body; mirrors generation._manual_decode with block-table
     writes instead of dense-cache slice updates). Parameterized by
@@ -406,7 +481,8 @@ def paged_decode_math(model, scratch_block, ids_t, seq_lens, tables,
             vv.astype(vc[i].dtype)))
         new_kc.append(kci)
         new_vc.append(vci)
-        att = _paged_attn(qv, kci, vci, tables, lens, ks=ksi, vs=vsi)
+        att = _paged_attn(qv, kci, vci, tables, lens, ks=ksi, vs=vsi,
+                          impl=attn_impl)
         att_t = Tensor(att.reshape(s, 1, h * d), stop_gradient=True)
         hidden = residual + attn.o_proj(att_t)
         hidden = hidden + layer.mlp(
@@ -674,6 +750,37 @@ class ServingEngine:
             clamped to never fall below that floor. Host-side
             accounting only; the compiled quantum and its golden are
             untouched. Default ``False``: the 2N floor, as before.
+        multi_quantum: MULTI-QUANTUM DECODE DRIVER. ``K > 1`` builds a
+            second quantum-family variant that runs UP TO K decode
+            quanta per dispatch under ``lax.while_loop``, re-entering
+            the host only when the scheduler's ``steady_state()``
+            predicate says admission could change (waiting queue
+            non-empty, a slot mid-prefill) or every row retired — the
+            on-device eos/max-len masks the quantum already carries
+            both retire rows mid-flight AND short-circuit the loop when
+            the whole batch is done. The driver accounts a K-quantum
+            dispatch as K quanta (obs histograms, cost ledger, flight
+            journals, watchdog normalization), so every conservation
+            invariant holds exactly, and its streams are BIT-IDENTICAL
+            to the per-quantum driver: between steady-state quanta the
+            host round-trips device state through int32 mirrors without
+            touching it, so folding K round-trips into the device loop
+            changes no math (tests pin greedy/sampling/prefix/int8/
+            preemption arms). Admission reservations already cover each
+            row's worst-case growth (``prompt + max_new + margin``), so
+            the K-wide block-table pre-growth can never oversubscribe
+            the pool. A speculative engine ignores K: each spec round
+            needs its acceptance counts on the host. Default ``1``: the
+            variant isn't built, nothing changes.
+        attn_impl: ``"fused"`` switches the decode quantum's inner loop
+            to the online-softmax block-streaming attention
+            (`_fused_paged_decode_attn` — flash-style m/l/acc over
+            block-table entries, no (S, W*BS, HK, D) gathered copy,
+            dead blocks clamped to pool block 0), the XLA-level port of
+            the Pallas paged kernel's DMA-elision trick. The gather
+            path stays the parity oracle; the ``serving_multiquantum_
+            step`` recipe pins the fused graph's own golden. Default
+            ``"gather"``: every existing graph byte-identical.
     """
 
     def __init__(self, model, num_slots=8, block_size=32, num_blocks=None,
@@ -684,7 +791,8 @@ class ServingEngine:
                  per_request_sampling=False, obs=None,
                  trace=False, slo=None, flight=None, mesh=None, tp=None,
                  faults=None, resilience=None, quantize=None,
-                 kv_dtype=None, cost_model=False):
+                 kv_dtype=None, cost_model=False, multi_quantum=1,
+                 attn_impl="gather"):
         cfg = model.config
         if getattr(cfg, "sliding_window", None):
             raise NotImplementedError(
@@ -706,6 +814,14 @@ class ServingEngine:
                 "per_request_sampling does not compose with spec_draft "
                 "yet: the speculative round's acceptance math takes the "
                 "engine-wide temperature")
+        if attn_impl not in ("gather", "fused"):
+            raise ValueError(
+                f"attn_impl must be gather|fused, got {attn_impl!r}")
+        self.attn_impl = attn_impl
+        self._mq_max = int(multi_quantum)
+        if self._mq_max < 1:
+            raise ValueError(
+                f"multi_quantum must be >= 1, got {multi_quantum}")
         self.mesh, self.tp = _resolve_tp_mesh(mesh, tp)
         if self.tp > 1:
             _check_tp_divisible(cfg, self.tp, "target")
@@ -872,6 +988,21 @@ class ServingEngine:
                 n_donatable=(4 if self.pool.quantized else 2)
                 * cfg.num_hidden_layers,
                 mesh=self.mesh)
+        # the multi-quantum while_loop variant: built ONLY when asked
+        # for (K > 1, non-speculative) — same signature as the plain
+        # quantum, so `_quantum_args()` feeds both; the default
+        # engine's compiled family and goldens never see it
+        self._mq_quantum = None
+        self._mq_audited = None
+        if self._mq_max > 1 and spec_draft is None:
+            self._mq_quantum = jax.jit(
+                self._make_quantum(multi=self._mq_max),
+                donate_argnums=(0, 1, 2, 3))
+            self._mq_audited = _AuditedStep(
+                self._mq_quantum,
+                n_donatable=(4 if self.pool.quantized else 2)
+                * cfg.num_hidden_layers,
+                name="serving_multiquantum_step", mesh=self.mesh)
         # under tp the small per-slot state rides every dispatch
         # committed replicated, so the compiled quantum's input layouts
         # are pinned (never re-inferred per call)
@@ -1082,11 +1213,25 @@ class ServingEngine:
         isolated by batch bisect and finished with
         ``finish_reason="error"``; a transient fault skips the step
         (nothing was dispatched, so the next step simply retries)."""
+        return self.step_collect(self.step_dispatch())
+
+    def step_dispatch(self):
+        """DISPATCH HALF of :meth:`step` — admit, then enqueue the
+        decode quantum WITHOUT forcing its results, returning an opaque
+        pending record for :meth:`step_collect` (or ``None`` when the
+        step completed synchronously: mixed prefill steps, speculative
+        rounds, fault-contained steps, and idle engines). JAX dispatch
+        is async, so between the two halves the device executes while
+        the host is free to run OTHER work — the cluster front door
+        dispatches every replica before collecting any, and a single
+        engine's ``step()`` is exactly ``step_collect(step_dispatch())``
+        (same ordering, same fault boundaries, bit-identical streams)."""
         self.stats["steps"] += 1
         if self.resilience is not None:
             self._audit_pools()
         if self.faults.armed:
             self.faults.maybe_corrupt(self.pool)
+        pending = None
         try:
             self._admit()
             live = self.scheduler.live()
@@ -1098,7 +1243,26 @@ class ServingEngine:
             if self.scheduler.prefilling():
                 self._mixed_step()
             elif self.scheduler.decoding():
-                self._decode_quantum()
+                pending = self._decode_dispatch()
+        except InjectedFault as e:
+            self._contain_fault(e)
+        finally:
+            if pending is None:
+                # the step ran to completion (or contained a fault)
+                # inside this half — close the fault boundary here
+                self._sync_faults()
+                self._sync_prefix_quarantines()
+        return pending
+
+    def step_collect(self, pending):
+        """COLLECT HALF of :meth:`step`: force the pending dispatch's
+        results, emit/account/retire, and close the step's fault
+        boundary. ``pending=None`` (the step already completed in
+        :meth:`step_dispatch`) just reports whether work remains."""
+        if pending is None:
+            return self.scheduler.has_work
+        try:
+            self._decode_collect(pending)
         except InjectedFault as e:
             self._contain_fault(e)
         finally:
@@ -1191,6 +1355,18 @@ class ServingEngine:
         if self._spec_disabled:
             return self._plain_audited, self._quantum_args()
         return self._audited, self._quantum_args()
+
+    def multiquantum_step_target(self):
+        """(auditable step, example args) for the MULTI-QUANTUM
+        while_loop variant — the exact object `_dispatch_quantum`
+        routes K > 1 dispatches through, fed by the same live-state
+        argument tuple as the plain quantum (identical signature). The
+        ``serving_multiquantum_step`` recipe fingerprints this."""
+        if self._mq_audited is None:
+            raise ValueError(
+                "engine built without multi_quantum>1 (or with "
+                "spec_draft): no multi-quantum variant to audit")
+        return self._mq_audited, self._quantum_args()
 
     def health(self, now=None):
         """Evaluate the engine's SLOs over the obs sample series: the
@@ -1398,7 +1574,7 @@ class ServingEngine:
             for r in self.scheduler.live():
                 self.flight.on_degrade(r, now, mode="spec_disabled")
 
-    def _guarded_dispatch(self, kind, rows):
+    def _guarded_dispatch(self, kind, rows, quanta=1):
         """One quantum dispatch under the resilience envelope: the
         injector's pre-dispatch check (faults fire BEFORE any donated
         buffer is consumed, so a retry re-runs against intact state),
@@ -1406,7 +1582,10 @@ class ServingEngine:
         the wall-clock watchdog. Real exceptions propagate untouched —
         fail-stop is preserved for anything the injector didn't
         cause. Isolation probes never retry (the raise IS the probe
-        signal), and poison faults escalate immediately."""
+        signal), and poison faults escalate immediately. ``quanta > 1``
+        dispatches the multi-quantum variant and normalizes the
+        watchdog wall by the quantum count, so a K-quantum dispatch is
+        judged against the same per-quantum calibration as K singles."""
         rids = [r.req_id for r in rows]
         pol = self.resilience
         attempt = 0
@@ -1414,7 +1593,7 @@ class ServingEngine:
             t0 = self._now()
             try:
                 self.faults.before_dispatch(kind, rids)
-                out = self._dispatch_quantum()
+                out = self._dispatch_quantum(quanta)
             except InjectedFault as e:
                 if kind == "spec_round" and e.poison is None:
                     self._note_spec_fault()
@@ -1440,7 +1619,7 @@ class ServingEngine:
                 pol.sleep(delay)
                 continue
             if self.watchdog is not None:
-                dt = self._now() - t0
+                dt = (self._now() - t0) / quanta
                 if self.watchdog.check(kind, dt):
                     self.obs.on_watchdog(kind, dt)
                     if kind == "spec_round":
@@ -1894,10 +2073,21 @@ class ServingEngine:
         return jax.vmap(jax.random.categorical)(
             step_keys, filt).astype(jnp.int32)
 
-    def _make_quantum(self):
+    def _make_quantum(self, multi=None):
+        """Build the decode-quantum callable. ``multi=None``: the plain
+        single-quantum scan, exactly as ever. ``multi=K``: the
+        MULTI-QUANTUM driver — the same scan wrapped in a
+        ``lax.while_loop`` that runs up to K quanta per dispatch,
+        short-circuiting on-device when every row's retirement mask
+        sets; tokens land in a (K, T, S) buffer and the loop counter
+        comes back so the host can account exactly the quanta that
+        ran. The K=1 graph is untouched — both wrappers call the same
+        ``scan_steps``."""
         model = self.model
         scratch = self._scratch_block
         t_steps = self.config.decode_quantum
+        n_slots = self.config.num_slots
+        attn_impl = self.attn_impl
         has_eos = self.eos_token_id is not None
         eos = -1 if self.eos_token_id is None else int(self.eos_token_id)
 
@@ -1913,7 +2103,8 @@ class ServingEngine:
                     def fwd(tok_t):
                         return paged_decode_math(
                             model, scratch, tok_t, seq_lens, tables,
-                            kc, vc, live, ks=ks, vs=vs)
+                            kc, vc, live, ks=ks, vs=vs,
+                            attn_impl=attn_impl)
 
                     (logits, kc2, vc2, ks2, vs2), _ = functional_call(
                         model, fwd,
@@ -1938,6 +2129,42 @@ class ServingEngine:
             return (kc, vc, ks, vs, seq_lens, last_tok, n_gen, done,
                     toks)
 
+        def multi_steps(kc, vc, ks, vs, p_vals, tables, seq_lens,
+                        last_tok, n_gen, done, max_new, keys, temps):
+            # K quanta per dispatch: the host round-trips device state
+            # untouched between steady-state quanta, so folding the
+            # round-trips into a while_loop changes no math — streams
+            # stay bit-identical to K sequential dispatches. The
+            # all-done cond is the on-device early exit; the returned
+            # counter tells the host how many quanta to account.
+            k_max = int(multi)
+            buf0 = jnp.zeros((k_max, t_steps, n_slots), jnp.int32)
+
+            def cond(carry):
+                qi, done = carry[0], carry[8]
+                return (qi < k_max) & ~jnp.all(done)
+
+            def body(carry):
+                (qi, kc, vc, ks, vs, seq_lens, last_tok, n_gen, done,
+                 buf) = carry
+                (kc, vc, ks, vs, seq_lens, last_tok, n_gen, done,
+                 toks) = scan_steps(kc, vc, ks, vs, p_vals, tables,
+                                    seq_lens, last_tok, n_gen, done,
+                                    max_new, keys, temps)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, toks[None], (qi, 0, 0))
+                return (qi + 1, kc, vc, ks, vs, seq_lens, last_tok,
+                        n_gen, done, buf)
+
+            (qi, kc, vc, ks, vs, seq_lens, last_tok, n_gen, done,
+             buf) = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), kc, vc, tuple(ks), tuple(vs), seq_lens,
+                 last_tok, n_gen, done, buf0))
+            return (kc, vc, ks, vs, seq_lens, last_tok, n_gen, done,
+                    buf, qi)
+
+        inner = scan_steps if multi is None else multi_steps
         if self._per_request_sampling:
             # the front-door variant: per-slot temperature rides the
             # existing per-slot state as ONE extra (S,) f32 input —
@@ -1945,15 +2172,15 @@ class ServingEngine:
             # this signature; the default quantum below is untouched
             def quantum(kc, vc, ks, vs, p_vals, tables, seq_lens,
                         last_tok, n_gen, done, max_new, keys, temps):
-                return scan_steps(kc, vc, ks, vs, p_vals, tables,
-                                  seq_lens, last_tok, n_gen, done,
-                                  max_new, keys, temps)
+                return inner(kc, vc, ks, vs, p_vals, tables,
+                             seq_lens, last_tok, n_gen, done,
+                             max_new, keys, temps)
         else:
             def quantum(kc, vc, ks, vs, p_vals, tables, seq_lens,
                         last_tok, n_gen, done, max_new, keys):
-                return scan_steps(kc, vc, ks, vs, p_vals, tables,
-                                  seq_lens, last_tok, n_gen, done,
-                                  max_new, keys, None)
+                return inner(kc, vc, ks, vs, p_vals, tables,
+                             seq_lens, last_tok, n_gen, done,
+                             max_new, keys, None)
 
         return quantum
 
@@ -1998,7 +2225,7 @@ class ServingEngine:
             args = args + (self._dev(self._temps),)
         return args
 
-    def _dispatch_quantum(self):
+    def _dispatch_quantum(self, quanta=1):
         """Run ONE quantum dispatch. Single chip: the jitted callable,
         exactly as before. Under tp: inside the engine's MeshScope
         (the first call's trace needs the mesh installed for the mp
@@ -2006,14 +2233,17 @@ class ServingEngine:
         executable when present — the census compile doubles as the
         serving executable. After a spec-disable degrade the PLAIN
         fallback quantum dispatches instead (the tp census executable
-        was compiled for the spec signature)."""
+        was compiled for the spec signature). ``quanta > 1`` routes to
+        the multi-quantum while_loop variant (same argument tuple)."""
         quantum = (self._plain_quantum if self._spec_disabled
                    else self._quantum)
+        if quanta > 1:
+            quantum = self._mq_quantum
         if self.mesh is None:
             return quantum(*self._quantum_args())
         with MeshScope(self.mesh):
             if (self._quantum_compiled is not None
-                    and not self._spec_disabled):
+                    and not self._spec_disabled and quanta == 1):
                 return self._quantum_compiled(*self._quantum_args())
             return quantum(*self._quantum_args())
 
@@ -2106,19 +2336,48 @@ class ServingEngine:
         self.obs.on_spec_round(now, g * len(rows), int(acc.sum()))
         self._retire_finished()
 
+    def _choose_k(self):
+        """How many decode quanta the NEXT dispatch may run on-device.
+        The multi-quantum cap applies only when the scheduler is in
+        steady state (batch composition CANNOT change before the
+        dispatch lands) and no host seam needs per-quantum visibility:
+        an armed fault injector or an in-flight bisect probe forces
+        per-quantum dispatch so fault attribution stays exact."""
+        if self._mq_quantum is None or self._isolating:
+            return 1
+        if self.faults.armed:
+            return 1
+        if not self.scheduler.steady_state():
+            return 1
+        return self._mq_max
+
     def _decode_quantum(self, include=None):
-        """Dispatch one jitted quantum; the single host sync per
-        ``decode_quantum`` tokens happens HERE, at the admit/retire
-        boundary, never inside the compiled loop. ``include`` restricts
-        the quantum to a subset of the decoding rows (the
-        bisect-quarantine probe path): excluded rows ride along
-        done-masked — inert through the dispatch — and their host
-        state is restored afterwards."""
+        """Dispatch + collect one decode step SYNCHRONOUSLY — the
+        single-engine path and the bisect probe. The overlap tier
+        (cluster pump, `step_dispatch`/`step_collect`) drives the two
+        halves separately instead."""
+        pending = self._decode_dispatch(include=include)
+        if pending is not None:
+            self._decode_collect(pending)
+
+    def _decode_dispatch(self, include=None):
+        """DISPATCH HALF of the decode step: grow block tables, enqueue
+        the jitted quantum (K quanta when `_choose_k` allows), adopt
+        the async donated pool outputs, and return a pending record for
+        `_decode_collect` — WITHOUT forcing a host sync, so the device
+        executes while the host moves on (the overlap the cluster pump
+        exploits). ``include`` restricts the quantum to a subset of the
+        decoding rows (the bisect-quarantine probe path): excluded rows
+        ride along done-masked — inert through the dispatch — and
+        their host state is restored at collect. A speculative round
+        (host needs its acceptance counts to proceed) runs to
+        completion here and returns None."""
         if self.spec_draft is not None and not self._spec_disabled:
-            return self._spec_round_step(include=include)
+            self._spec_round_step(include=include)
+            return None
         t0 = self._now()
-        self.stats["decode_quanta"] += 1
         t_steps = self.config.decode_quantum
+        k = 1 if include is not None else self._choose_k()
         rows = self.scheduler.decoding()
         excluded = []
         if include is not None:
@@ -2128,33 +2387,54 @@ class ServingEngine:
             for r in excluded:
                 self._done[r.slot] = True
         try:
-            # grow each live slot's block table to cover the quantum
-            # before entering the device loop (tables static inside)
+            # grow each live slot's block table to cover the whole
+            # dispatch (K quanta) before entering the device loop
+            # (tables static inside); capped by the request's own
+            # prompt+max_new bound, which admission already reserved —
+            # K-wide growth can never oversubscribe the pool
             for req in rows:
                 slot = req.slot
                 cap = req.prompt_len + req.max_new_tokens - 1
-                need = min(int(self._seq_lens[slot]) + t_steps, cap)
-                if need > self.pool.seq_len(req.req_id):
-                    self.pool.ensure(req.req_id, need)
-                if self.prefix_cache:
-                    self.pool.make_writable(
-                        req.req_id, int(self._seq_lens[slot]), need)
-                row = self.pool.block_table_array(
-                    [req.req_id], pad_to=self._table_width)
-                self._tables[slot] = np.asarray(row)[0][
-                    :self._table_width]
-            kc, vc, ks, vs, seq_lens, last_tok, n_gen, done, toks = \
-                self._guarded_dispatch("decode", rows)
+                need = min(int(self._seq_lens[slot]) + k * t_steps, cap)
+                row = self.pool.grow_decode_table(
+                    req.req_id, need, int(self._seq_lens[slot]),
+                    pad_to=self._table_width, cow=self.prefix_cache)
+                self._tables[slot] = row[:self._table_width]
+            out = self._guarded_dispatch("decode", rows, quanta=k)
         except BaseException:
             for r in excluded:
                 self._done[r.slot] = r.finished
             raise
+        if k > 1:
+            (kc, vc, ks, vs, seq_lens, last_tok, n_gen, done, toks,
+             nq) = out
+        else:
+            kc, vc, ks, vs, seq_lens, last_tok, n_gen, done, toks = out
+            nq = None
+        # adopt the donated pool outputs NOW (async handles — no sync):
+        # the pre-dispatch buffers were consumed by donation
         self.pool.k_pools = list(kc)
         self.pool.v_pools = list(vc)
         if self.pool.quantized:
             self.pool.k_scales = list(ks)
             self.pool.v_scales = list(vs)
-        toks = np.asarray(toks)                          # (T, S) sync
+        return {"rows": rows, "excluded": excluded, "t0": t0,
+                "t_disp": self._now(), "k": k,
+                "out": (seq_lens, last_tok, n_gen, done, toks, nq)}
+
+    def _decode_collect(self, pending):
+        """COLLECT HALF of the decode step: force the device results
+        (the ONE host sync per dispatch), refresh the host mirrors,
+        emit every generated token, account the dispatch as the
+        ``n_exec`` quanta that actually ran (obs histograms, cost
+        ledger, host-gap gauge — each sub-quantum gets an equal slice
+        of the wall, so the conservation invariants partition exactly),
+        and retire finished rows."""
+        rows, excluded = pending["rows"], pending["excluded"]
+        t0, k = pending["t0"], pending["k"]
+        seq_lens, last_tok, n_gen, done, toks, nq = pending["out"]
+        t_steps = self.config.decode_quantum
+        toks = np.asarray(toks)                          # sync
         self._seq_lens = np.asarray(seq_lens).copy()
         self._last_tok = np.asarray(last_tok).copy()
         self._n_gen = np.asarray(n_gen).copy()
@@ -2163,24 +2443,44 @@ class ServingEngine:
             # a masked row's device state carried through unchanged;
             # only its done flag was forced — restore the host truth
             self._done[r.slot] = r.finished
+        if k > 1:
+            # (K, T, S) buffer + on-device loop counter: keep only the
+            # quanta that ran before the all-done early exit fired
+            n_exec = int(np.asarray(nq))
+            toks = toks[:n_exec].reshape(-1, toks.shape[2])
+            n_exec = max(n_exec, 1)
+        else:
+            n_exec = 1                                   # (T, S)
+        self.stats["decode_quanta"] += n_exec
         self.stats["quantum_tokens"] += int(toks.shape[0]) * int(
             toks.shape[1])
         now = self._now()
-        emitted = 0
+        device_s = max(now - pending["t_disp"], 0.0)
+        emitted_k = [0] * n_exec
         for req in rows:
             slot = req.slot
             got = 0
-            for k in range(toks.shape[0]):
+            for j in range(toks.shape[0]):
                 if req.finished:
                     break
-                self._emit(req, int(toks[k, slot]))
-                emitted += 1
+                self._emit(req, int(toks[j, slot]))
+                emitted_k[j // t_steps] += 1
                 got += 1
             if self.flight is not None and got:
                 self.flight.on_quantum_tokens(req, now, got)
             if req.finished:
                 req.finish_time = now
-        self.obs.on_quantum("decode", t0, now, emitted, len(rows))
+        # a K-quantum dispatch is K quanta to every seam downstream:
+        # the sub-intervals partition [t0, now] exactly (last edge IS
+        # `now`), so Σ phase seconds == histogram sums stays exact
+        dt = (now - t0) / n_exec
+        dev_dt = device_s / n_exec
+        prev = t0
+        for j in range(n_exec):
+            edge = now if j == n_exec - 1 else t0 + (j + 1) * dt
+            self.obs.on_quantum("decode", prev, edge, emitted_k[j],
+                                len(rows), device_s=dev_dt)
+            prev = edge
         self._retire_finished()
 
     def _retire_finished(self):

@@ -363,6 +363,26 @@ class ServingFrontDoor:
         self._reap_finished()
         return alive
 
+    def pump_dispatch(self):
+        """DISPATCH HALF of :meth:`pump` — preemption policy + the
+        engine's async :meth:`~ServingEngine.step_dispatch`. Returns
+        the opaque pending record for :meth:`pump_collect`. The cluster
+        front door drives every replica's dispatch half before any
+        collect half, so no replica's host work serializes on another
+        replica's device wall; ``pump()`` is equivalent to
+        ``pump_collect(pump_dispatch())`` (it goes through
+        ``engine.step()`` — the composition of the same two halves — so
+        wrappers around ``step`` still see every pump)."""
+        self._apply_preemption()
+        return self.engine.step_dispatch()
+
+    def pump_collect(self, pending):
+        """COLLECT HALF of :meth:`pump`: force the pending dispatch,
+        reap finished streams, report whether work remains."""
+        alive = self.engine.step_collect(pending)
+        self._reap_finished()
+        return alive
+
     def run_until_idle(self):
         """Drive synchronously until no work remains; returns the
         engine's completed-request list."""

@@ -459,3 +459,17 @@ class Scheduler:
     @property
     def has_work(self):
         return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def steady_state(self):
+        """True when the batch composition CANNOT change before the
+        next dispatch: nothing is waiting for admission, no slot is
+        mid-prefill, and at least one slot is decoding. This is the
+        predicate the engine's multi-quantum driver consults to decide
+        how many decode quanta to run per dispatch — in steady state
+        the host has no scheduling decision to make between quanta
+        (retirement is handled by the on-device eos/max-len masks, and
+        the admission reservation already covers every live row's
+        worst-case growth), so re-entering Python between them buys
+        nothing."""
+        return (not self.waiting and not self.prefilling()
+                and bool(self.decoding()))

@@ -16,7 +16,8 @@ serving_engine | speculative_decode | speculative_serving |
 serving_obs_overhead | fault_recovery_overhead |
 attribution_overhead | slo_overhead |
 serving_overload |
-shared_prefix | serving_tp | serving_int8 | serving_cluster
+shared_prefix | serving_tp | serving_int8 | serving_cluster |
+dispatch_decomposition
 (the 7B-shape Llama MFU headline also lives in bench.py; the suite row
 keeps the fallback-variant detail, llama_941m_train tracks the
 rounds-1..3 headline config, llama_941m_packed_train the ragged
@@ -1069,6 +1070,17 @@ def serving_cluster():
     return _bench_serving().serving_cluster()
 
 
+def dispatch_decomposition():
+    """Multi-quantum host-gap acceptance row (ISSUE 17): steady-state
+    decode dispatch wall time decomposed into host-side scheduling vs
+    the device program across K in {1, 4, 16} on-device quanta per
+    dispatch, plus the fused paged-attention path vs the XLA-gather
+    oracle — host us/token at K=16 over K=1 must be < 1 and
+    every arm's greedy streams are asserted bit-identical in-run (see
+    scripts/bench_serving.py, artifact BENCH_HOSTGAP_r18.json)."""
+    return _bench_serving().dispatch_decomposition()
+
+
 CONFIGS = {
     "graph_audit": graph_audit,
     "graph_fingerprint": graph_fingerprint,
@@ -1085,6 +1097,7 @@ CONFIGS = {
     "serving_tp": serving_tp,
     "serving_int8": serving_int8,
     "serving_cluster": serving_cluster,
+    "dispatch_decomposition": dispatch_decomposition,
     "resnet50_eager": resnet50_eager,
     "resnet50_jit": resnet50_jit,
     "gpt2_jit": gpt2_jit,

@@ -94,6 +94,21 @@ python -m paddle_tpu.analysis --check --fingerprint --cost
 # caps ride `--check`; the exact counts ride the goldens; the
 # cross-source ratio is also budget-guarded in BENCH_COST_r17.json.
 #
+# Multi-quantum gate (ISSUE 17): `--check --fingerprint` above also
+# audits `serving_multiquantum_step` — the K=4 on-device decode driver
+# (lax.while_loop over the scanned quantum, retiring rows against the
+# eos/max-len masks WITHOUT re-entering the host) with the fused
+# online-softmax paged-attention inner loop. Its budget keeps 0 host
+# callbacks + full pool donation and pins the fused path's structural
+# win: temp bytes <=12 KB per dispatch (the gather path audits
+# ~207 KB — the w*bs-wide gathered K/V staging the fused loop elides).
+# The single-quantum recipes' goldens must stay byte-identical: K=1
+# engines build the exact same scanned quantum, and the XLA-gather
+# attention stays the default parity oracle. Note the jaxpr-walker
+# HBM cap is loose (13 MB/token): the walker charges the block-scan's
+# gathered operands once PER BLOCK STEP while XLA's compiled report
+# reads ~717 KB/dispatch; the flops agreement band still gates.
+#
 # Cluster gate (ISSUE 15): the router is pure host code riding the
 # same engines, so `--check --fingerprint` above (0 host callbacks,
 # byte-identical goldens) already proves the cluster tier touches no

@@ -228,6 +228,76 @@ def test_router_rejects_mismatched_fleets():
         e1, e2 = _StubEngine(), _StubEngine()
         ClusterRouter([ClusterReplica("x", e1),
                        ClusterReplica("x", e2)])
+
+
+def test_cluster_drain_interleaves_replicas():
+    """Regression (ISSUE 17 satellite): ``ClusterFrontDoor.drain()``
+    used to run each replica's door to completion in ring order, so
+    replica 0's whole backlog drained before replica N-1 took a single
+    step — its accepted requests aged by the sum of every earlier
+    replica's backlog. The coordinated drain now pumps the fleet
+    interleaved (one overlapped pass per replica per round), so for
+    equal backlogs the per-replica step skew stays bounded at 1 at
+    EVERY point of the drain, and each door's own ``drain()`` runs on
+    an already-idle engine."""
+    ledger = []
+
+    class _DrainObs(_StubObs):
+        def on_drain(self, *a, **k):
+            pass
+
+    class _DrainEngine(_StubEngine):
+        def __init__(self, steps):
+            super().__init__()
+            self.obs = _DrainObs()
+            self.steps_left = steps
+
+        @property
+        def has_work(self):
+            return self.steps_left > 0
+
+    class _DrainDoor:
+        """Counting stand-in for ServingFrontDoor's pump halves."""
+
+        def __init__(self, engine, name):
+            self.engine = engine
+            self._name = name
+            self._draining = False
+
+        @property
+        def draining(self):
+            return self._draining
+
+        def pump_dispatch(self):
+            return self._name  # the pending token the collect half eats
+
+        def pump_collect(self, pending):
+            assert pending == self._name
+            self.engine.steps_left -= 1
+            ledger.append(self._name)
+            return self.engine.has_work
+
+        def drain(self, flight_path=None):
+            assert not self.engine.has_work, \
+                "per-door drain must run on an already-idle engine"
+            return {"completed": 0, "shed": 0,
+                    "preempted": 0, "resumed": 0}
+
+    n_steps = 8
+    reps = []
+    for name in ("a", "b"):
+        eng = _DrainEngine(n_steps)
+        reps.append(ClusterReplica(name, eng,
+                                   door=_DrainDoor(eng, name)))
+    cfd = ClusterFrontDoor(ClusterRouter(reps))
+    summary = cfd.drain()
+    assert summary["drained"]
+    assert len(ledger) == 2 * n_steps
+    counts = {"a": 0, "b": 0}
+    for name in ledger:
+        counts[name] += 1
+        assert abs(counts["a"] - counts["b"]) <= 1, (
+            f"replica step skew exceeded 1 mid-drain: {ledger}")
     with pytest.raises(ValueError):
         ClusterRouter([])
 
